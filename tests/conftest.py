@@ -1,0 +1,25 @@
+"""Test fixtures: an 8-device virtual CPU mesh stands in for a TPU slice.
+
+Mirrors the reference's single-machine test strategy (SURVEY.md §4: every
+"distributed" test runs on one machine — Spark local mode + local Ray; fixture
+at pyzoo/test/zoo/orca/learn/ray/pytorch/conftest.py:22-40). Here the fake
+backend is JAX CPU with xla_force_host_platform_device_count=8.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="package")
+def orca_context():
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    ctx = init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+    yield ctx
+    stop_orca_context()

@@ -1,0 +1,57 @@
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import get_context, init_orca_context, stop_orca_context
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+def test_init_local_context(orca_context):
+    ctx = orca_context
+    assert ctx.num_devices == 8
+    assert dict(ctx.mesh.shape)["dp"] == 8
+    assert ctx.is_coordinator()
+
+
+def test_get_context_returns_singleton(orca_context):
+    assert get_context() is orca_context
+
+
+def test_resolve_axis_sizes():
+    s = mesh_lib.resolve_axis_sizes(8, {"dp": -1})
+    assert s["dp"] == 8 and s["tp"] == 1
+    s = mesh_lib.resolve_axis_sizes(8, {"dp": -1, "tp": 2})
+    assert s["dp"] == 4 and s["tp"] == 2
+    with pytest.raises(ValueError):
+        mesh_lib.resolve_axis_sizes(8, {"dp": 3})
+    with pytest.raises(ValueError):
+        mesh_lib.resolve_axis_sizes(8, {"dp": -1, "tp": -1})
+
+
+def test_mesh_axes_config():
+    stop_orca_context()
+    ctx = init_orca_context("cpu-sim", mesh_axes={"dp": 2, "tp": 2, "sp": 2})
+    try:
+        assert dict(ctx.mesh.shape) == {"dp": 2, "fsdp": 1, "tp": 2, "sp": 2}
+    finally:
+        stop_orca_context()
+
+
+def test_batch_divisor(orca_context):
+    assert mesh_lib.batch_divisor(orca_context.mesh) == 8
+
+
+def test_collectives_shard_map(orca_context):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from analytics_zoo_tpu.parallel import collective as C
+
+    mesh = orca_context.mesh
+
+    def f(x):
+        return C.grad_allreduce_mean(x, axes=("dp",))
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=P(("dp",)),
+                            out_specs=P(("dp",))))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.5))
