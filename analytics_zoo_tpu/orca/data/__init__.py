@@ -1,0 +1,3 @@
+from .shard import HostXShards, SharedValue, SparkXShards, XShards
+
+__all__ = ["XShards", "HostXShards", "SparkXShards", "SharedValue"]

@@ -1,0 +1,88 @@
+"""File readers producing XShards of pandas DataFrames.
+
+Mirrors the reference's ``zoo.orca.data.pandas.preprocessing`` (read_csv:24,
+read_json:37, read_parquet:271) minus the Spark backend: files are globbed,
+split across host processes (each TPU host reads only its slice — the
+file-level sharding the reference calls ``auto_shard_files``), and parsed on a
+thread pool with pandas or pyarrow.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import List, Optional
+
+from ....common.config import OrcaContext
+from ....common.context import get_context
+from ..shard import HostXShards, _pmap
+
+
+def _expand_paths(file_path: str, ext: Optional[str] = None) -> List[str]:
+    paths: List[str] = []
+    for piece in file_path.split(","):
+        piece = piece.strip()
+        if os.path.isdir(piece):
+            found = sorted(
+                p for p in _glob.glob(os.path.join(piece, "**", "*"),
+                                      recursive=True)
+                if os.path.isfile(p) and not os.path.basename(p).startswith(
+                    ("_", ".")))
+            if ext:
+                found = [p for p in found if p.endswith(ext)]
+            paths.extend(found)
+        else:
+            expanded = sorted(_glob.glob(piece)) if any(
+                c in piece for c in "*?[") else [piece]
+            paths.extend(expanded)
+    if not paths:
+        raise FileNotFoundError(f"no input files match {file_path}")
+    # multihost: each process reads its own stripe of the file list
+    import jax
+    pid, n = jax.process_index(), jax.process_count()
+    local = paths[pid::n] if n > 1 else paths
+    return local
+
+
+def read_csv(file_path: str, **kwargs) -> HostXShards:
+    """Read csv file(s)/dir/glob into an XShards of pandas DataFrames
+    (reference: orca/data/pandas/preprocessing.py:24)."""
+    return _read_files(file_path, "csv", **kwargs)
+
+
+def read_json(file_path: str, **kwargs) -> HostXShards:
+    """(reference: orca/data/pandas/preprocessing.py:37)"""
+    return _read_files(file_path, "json", **kwargs)
+
+
+def read_parquet(file_path: str, columns=None, **options) -> HostXShards:
+    """(reference: orca/data/pandas/preprocessing.py:271)"""
+    paths = _expand_paths(file_path, ext=None)
+    paths = [p for p in paths if p.endswith(".parquet") or os.path.isfile(p)]
+
+    def load(p):
+        import pandas as pd
+        return pd.read_parquet(p, columns=columns, **options)
+
+    return HostXShards(_pmap(load, paths))
+
+
+def _read_files(file_path: str, file_type: str, **kwargs) -> HostXShards:
+    paths = _expand_paths(file_path)
+    backend = OrcaContext.pandas_read_backend
+
+    def load(p):
+        import pandas as pd
+        if file_type == "json":
+            return pd.read_json(p, **kwargs)
+        if backend == "pyarrow" and not kwargs:
+            from pyarrow import csv as pacsv
+            return pacsv.read_csv(p).to_pandas()
+        return pd.read_csv(p, **kwargs)
+
+    shards = HostXShards(_pmap(load, paths))
+    ctx = get_context()
+    target = max(len(ctx.local_devices), 1)
+    if shards.num_partitions() < target and len(shards) >= target:
+        shards = shards.repartition(target)
+    return shards

@@ -1,0 +1,278 @@
+"""XShards — the distributed data-shard abstraction, TPU-native.
+
+The reference's ``SparkXShards`` (pyzoo/zoo/orca/data/shard.py:129) is an RDD
+of numpy/pandas/list elements living on Spark executors; the Ray path copies
+partitions into per-node plasma stores (pyzoo/zoo/orca/data/ray_xshards.py:67).
+On TPU there is no JVM and no actor store: each host process owns its
+partitions as host-local numpy/pandas chunks, transforms run on a thread pool
+(numpy releases the GIL), and the estimator bridges partitions into HBM with
+``jax.make_array_from_process_local_data``. The public API mirrors the
+reference's shard semantics (transform_shard/collect/repartition/partition_by/
+unique/split/zip/save_pickle/__getitem__, shard.py:30-470) so user pipelines
+port unchanged.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import pickle
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...common.context import get_context
+from ...utils import nest
+
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        _POOL = ThreadPoolExecutor(max_workers=min(32, (os.cpu_count() or 4)))
+    return _POOL
+
+
+def _pmap(fn, items):
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    return list(_pool().map(fn, items))
+
+
+class XShards:
+    """Abstract shard collection (reference: orca/data/shard.py:25)."""
+
+    def transform_shard(self, func: Callable, *args) -> "XShards":
+        raise NotImplementedError
+
+    def collect(self) -> List[Any]:
+        raise NotImplementedError
+
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    @classmethod
+    def load_pickle(cls, path: str, minPartitions: Optional[int] = None
+                    ) -> "HostXShards":
+        """Load shards saved by :meth:`HostXShards.save_pickle`
+        (reference: shard.py:60)."""
+        paths = sorted(_glob.glob(os.path.join(path, "part-*.pkl")))
+        if not paths:
+            raise FileNotFoundError(f"no part-*.pkl under {path}")
+        parts = []
+        for p in paths:
+            with open(p, "rb") as f:
+                parts.extend(pickle.load(f))
+        shards = HostXShards(parts)
+        if minPartitions and shards.num_partitions() < minPartitions:
+            shards = shards.repartition(minPartitions)
+        return shards
+
+    @staticmethod
+    def partition(data: Any, num_shards: Optional[int] = None) -> "HostXShards":
+        """Partition an in-memory ndarray/list/dict-of-ndarray into shards by
+        splitting along the first dimension of every leaf (reference
+        semantics: orca/data/shard.py:73-126)."""
+        ctx = get_context()
+        n = num_shards or max(len(ctx.local_devices), 1)
+        flat = nest.flatten(data)
+        if not flat:
+            raise ValueError("empty data")
+        lengths = {len(a) for a in flat}
+        if len(lengths) != 1:
+            raise ValueError(
+                f"leaves must share first-dim length, got {sorted(lengths)}")
+        total = lengths.pop()
+        if n > total:
+            raise ValueError(
+                f"number of shards {n} exceeds first-dim length {total}")
+        parts = []
+        for i in range(n):
+            idx = np.arange(i, total, n)  # round-robin like the reference
+            part_flat = [a[idx] if isinstance(a, np.ndarray)
+                         else [a[j] for j in idx] for a in flat]
+            parts.append(nest.pack_sequence_as(data, part_flat))
+        return HostXShards(parts)
+
+
+class HostXShards(XShards):
+    """Host-local shard collection: a list of partitions, each one element
+    (numpy dict, pandas DataFrame, or arbitrary object) — the TPU-native
+    stand-in for both SparkXShards and RayXShards."""
+
+    def __init__(self, partitions: Sequence[Any], transient: bool = False):
+        self._parts: List[Any] = list(partitions)
+        self.transient = transient
+
+    # --- core ---------------------------------------------------------------
+    def transform_shard(self, func: Callable, *args) -> "HostXShards":
+        """Apply ``func(shard, *args)`` to every partition in parallel
+        (reference: shard.py:146-163)."""
+        return HostXShards(_pmap(lambda p: func(p, *args), self._parts))
+
+    def collect(self) -> List[Any]:
+        return list(self._parts)
+
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def cache(self) -> "HostXShards":
+        self.transient = False
+        return self
+
+    def uncache(self) -> "HostXShards":
+        self.transient = True
+        return self
+
+    def is_cached(self) -> bool:
+        return not self.transient
+
+    def compute(self) -> "HostXShards":
+        return self
+
+    # --- reshaping ----------------------------------------------------------
+    def repartition(self, num_partitions: int) -> "HostXShards":
+        """Coalesce/split partitions. For dict-of-ndarray or DataFrame shards
+        the rows are concatenated then re-split evenly (reference merges rows
+        the same way, shard.py:219-293)."""
+        parts = self._parts
+        if not parts:
+            return HostXShards([])
+        first = parts[0]
+        if isinstance(first, dict):
+            merged = {
+                k: np.concatenate([p[k] for p in parts]) for k in first}
+            total = len(nest.flatten(merged)[0])
+            splits = np.array_split(np.arange(total), num_partitions)
+            return HostXShards([
+                {k: v[idx] for k, v in merged.items()} for idx in splits])
+        try:
+            import pandas as pd
+            if isinstance(first, pd.DataFrame):
+                merged_df = pd.concat(parts, ignore_index=True)
+                splits = np.array_split(np.arange(len(merged_df)),
+                                        num_partitions)
+                return HostXShards([
+                    merged_df.iloc[idx].reset_index(drop=True)
+                    for idx in splits])
+        except ImportError:
+            pass
+        if isinstance(first, (list, np.ndarray)):
+            flat = [x for p in parts for x in p]
+            chunks = np.array_split(np.arange(len(flat)), num_partitions)
+            return HostXShards([[flat[i] for i in idx] for idx in chunks])
+        # opaque elements: round-robin regroup
+        groups: List[List[Any]] = [[] for _ in range(num_partitions)]
+        for i, p in enumerate(parts):
+            groups[i % num_partitions].append(p)
+        return HostXShards([g if len(g) != 1 else g[0] for g in groups])
+
+    def partition_by(self, cols, num_partitions: Optional[int] = None
+                     ) -> "HostXShards":
+        """Hash-partition pandas-DataFrame shards by column values
+        (reference: shard.py:295-340)."""
+        import pandas as pd
+        dfs = [p for p in self._parts if isinstance(p, pd.DataFrame)]
+        if len(dfs) != len(self._parts):
+            raise ValueError("partition_by requires pandas DataFrame shards")
+        if isinstance(cols, str):
+            cols = [cols]
+        merged = pd.concat(dfs, ignore_index=True)
+        n = num_partitions or self.num_partitions()
+        keys = pd.util.hash_pandas_object(merged[cols], index=False).to_numpy()
+        assignment = keys % n
+        return HostXShards([
+            merged[assignment == i].reset_index(drop=True) for i in range(n)])
+
+    def unique(self) -> np.ndarray:
+        """Distinct elements across all partitions (reference: shard.py:341;
+        shards must be 1-D arrays/Series)."""
+        vals = [np.asarray(p) for p in self._parts]
+        return np.unique(np.concatenate(vals))
+
+    def split(self) -> List["HostXShards"]:
+        """Split shards whose elements are tuples/lists of N parts into N
+        XShards (reference: shard.py:360-388)."""
+        lens = {len(p) for p in self._parts}
+        if len(lens) != 1:
+            raise ValueError("each shard must have the same number of elements")
+        n = lens.pop()
+        return [HostXShards([p[i] for p in self._parts]) for i in range(n)]
+
+    def zip(self, other: "HostXShards") -> "HostXShards":
+        """Pair partitions elementwise (reference: shard.py:389-412)."""
+        if not isinstance(other, HostXShards):
+            raise ValueError("zip requires another HostXShards")
+        if self.num_partitions() != other.num_partitions():
+            raise ValueError("XShards should have the same number of partitions")
+        def _n(p):
+            flat = nest.flatten(p)
+            return len(flat[0]) if flat else 0
+        for a, b in zip(self._parts, other._parts):
+            if _n(a) != _n(b):
+                raise ValueError(
+                    "elements in corresponding partitions must count equal rows")
+        return HostXShards(list(zip(self._parts, other._parts)))
+
+    # --- persistence --------------------------------------------------------
+    def save_pickle(self, path: str, batchSize: int = 10) -> "HostXShards":
+        os.makedirs(path, exist_ok=True)
+        for i in range(0, len(self._parts), batchSize):
+            fname = os.path.join(path, f"part-{i // batchSize:05d}.pkl")
+            with open(fname, "wb") as f:
+                pickle.dump(self._parts[i:i + batchSize], f)
+        return self
+
+    # --- accessors ----------------------------------------------------------
+    def __len__(self) -> int:
+        def _count(p):
+            flat = nest.flatten(p)
+            leaf = flat[0] if flat else []
+            try:
+                return len(leaf)
+            except TypeError:
+                return 1
+        return sum(_count(p) for p in self._parts)
+
+    def __getitem__(self, key: str) -> "HostXShards":
+        """Column/key selection on dict or DataFrame shards
+        (reference: shard.py:432-442)."""
+        def get_data(p):
+            if isinstance(p, dict):
+                return p[key]
+            return p[key]  # pandas column
+        return HostXShards(_pmap(get_data, self._parts), transient=True)
+
+    def _get_class_name(self) -> str:
+        return type(self._parts[0]).__name__ if self._parts else "empty"
+
+    def to_local(self) -> "HostXShards":
+        return self
+
+    def __repr__(self):
+        return (f"HostXShards(num_partitions={self.num_partitions()}, "
+                f"element={self._get_class_name()})")
+
+
+# Source-compat alias: the reference exposes SparkXShards; existing user code
+# that type-checks against the name keeps working.
+SparkXShards = HostXShards
+
+
+class SharedValue:
+    """Broadcast-variable stand-in (reference: shard.py:472-485). On a single
+    controller per host there is nothing to broadcast; kept for API parity."""
+
+    def __init__(self, data):
+        self._data = data
+        self.id = uuid.uuid4().hex
+
+    @property
+    def value(self):
+        return self._data
+
+    def unpersist(self):
+        self._data = None

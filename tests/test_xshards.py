@@ -1,0 +1,101 @@
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.orca.data import HostXShards, SharedValue, XShards
+from analytics_zoo_tpu.orca.data.pandas import read_csv, read_json, read_parquet
+from analytics_zoo_tpu.utils import nest
+
+
+@pytest.fixture
+def csv_dir(tmp_path):
+    for i in range(4):
+        df = pd.DataFrame({
+            "user": np.arange(i * 10, i * 10 + 10),
+            "item": np.arange(10),
+            "label": np.random.RandomState(i).randint(0, 2, 10),
+        })
+        df.to_csv(tmp_path / f"part{i}.csv", index=False)
+    return str(tmp_path)
+
+
+def test_nest_roundtrip():
+    s = {"x": [np.zeros(2), np.ones(3)], "y": np.arange(4)}
+    flat = nest.flatten(s)
+    assert len(flat) == 3
+    packed = nest.pack_sequence_as(s, flat)
+    np.testing.assert_array_equal(packed["y"], np.arange(4))
+
+
+def test_partition_ndarray(orca_context):
+    data = {"x": np.arange(100).reshape(100, 1), "y": np.arange(100)}
+    shards = XShards.partition(data, num_shards=4)
+    assert shards.num_partitions() == 4
+    assert len(shards) == 100
+    col = shards["y"]
+    total = np.sort(np.concatenate(col.collect()))
+    np.testing.assert_array_equal(total, np.arange(100))
+
+
+def test_transform_and_repartition(orca_context):
+    data = {"x": np.random.rand(64, 3), "y": np.zeros(64)}
+    shards = XShards.partition(data, num_shards=8)
+    doubled = shards.transform_shard(lambda d: {"x": d["x"] * 2, "y": d["y"]})
+    assert doubled.num_partitions() == 8
+    re = doubled.repartition(2)
+    assert re.num_partitions() == 2
+    assert len(re) == 64
+
+
+def test_read_csv(orca_context, csv_dir):
+    shards = read_csv(csv_dir)
+    assert len(shards) == 40
+    df = shards.collect()[0]
+    assert list(df.columns) == ["user", "item", "label"]
+
+
+def test_partition_by_and_unique(orca_context, csv_dir):
+    shards = read_csv(csv_dir)
+    parted = shards.partition_by("user", num_partitions=3)
+    assert parted.num_partitions() == 3
+    users = np.sort(parted["user"].unique())
+    np.testing.assert_array_equal(users, np.arange(40))
+
+
+def test_split_and_zip(orca_context):
+    a = XShards.partition({"x": np.arange(20)}, num_shards=4)
+    b = a.transform_shard(lambda d: {"x": d["x"] * 10})
+    z = a.zip(b)
+    first = z.collect()[0]
+    np.testing.assert_array_equal(first[0]["x"] * 10, first[1]["x"])
+    pairs = z.split()
+    assert len(pairs) == 2
+    assert pairs[0].num_partitions() == 4
+
+
+def test_save_load_pickle(orca_context, tmp_path):
+    data = {"x": np.arange(30)}
+    shards = XShards.partition(data, num_shards=3)
+    shards.save_pickle(str(tmp_path / "out"))
+    loaded = XShards.load_pickle(str(tmp_path / "out"))
+    assert len(loaded) == 30
+    assert loaded.num_partitions() == 3
+
+
+def test_read_json_parquet(orca_context, tmp_path):
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    df.to_json(tmp_path / "d.json")
+    df.to_parquet(tmp_path / "d.parquet")
+    js = read_json(str(tmp_path / "d.json"))
+    assert len(js) == 3
+    pq = read_parquet(str(tmp_path / "d.parquet"))
+    assert list(pq.collect()[0].columns) == ["a", "b"]
+
+
+def test_shared_value():
+    sv = SharedValue({"vocab": 100})
+    assert sv.value["vocab"] == 100
+    sv.unpersist()
+    assert sv.value is None
