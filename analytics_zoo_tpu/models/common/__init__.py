@@ -1,0 +1,3 @@
+from .zoo_model import ZooModel
+
+__all__ = ["ZooModel"]

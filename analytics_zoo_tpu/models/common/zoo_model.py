@@ -1,0 +1,78 @@
+"""Common base for built-in models — the reference's ZooModel
+(pyzoo/zoo/models/common/zoo_model.py: predict/save_model/load_model surface)
+reworked as a thin holder of a flax module + trained state that cooperates
+with the Orca estimator."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class ZooModel:
+    def __init__(self, module):
+        self.module = module
+        self._estimator = None  # set after compile/fit
+
+    # --- training hookup ----------------------------------------------------
+    def compile(self, loss=None, optimizer="adam", metrics=None, **kwargs):
+        from ...orca.learn.estimator import TPUEstimator
+        self._estimator = TPUEstimator(self.module, loss=loss,
+                                       optimizer=optimizer, metrics=metrics,
+                                       **kwargs)
+        return self
+
+    @property
+    def estimator(self):
+        if self._estimator is None:
+            self.compile()
+        return self._estimator
+
+    def fit(self, data, **kwargs):
+        return self.estimator.fit(data, **kwargs)
+
+    def evaluate(self, data, **kwargs):
+        return self.estimator.evaluate(data, **kwargs)
+
+    def predict(self, x, batch_size: int = 1024, **kwargs) -> np.ndarray:
+        est = self.estimator
+        if isinstance(x, np.ndarray) or (
+                isinstance(x, (list, tuple)) and
+                all(isinstance(a, np.ndarray) for a in x)):
+            return est.predict({"x": x}, batch_size=batch_size, **kwargs)
+        return est.predict(x, batch_size=batch_size, **kwargs)
+
+    # --- persistence --------------------------------------------------------
+    def save_model(self, path: str, over_write: bool = False):
+        import os
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(path)
+        state = self.estimator.engine.get_state()
+        with open(path, "wb") as f:
+            pickle.dump({"module_cfg": self._module_config(), "state": state,
+                         "cls": type(self).__name__}, f)
+        return path
+
+    def _module_config(self):
+        try:
+            import dataclasses
+            return dataclasses.asdict(self.module)
+        except Exception:
+            return {}
+
+    @classmethod
+    def load_model(cls, path: str):
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        obj = cls.__new__(cls)
+        # subclasses with non-trivial __init__ should override; generic path
+        # rebuilds from dataclass config.
+        raise NotImplementedError(
+            "use the estimator save/load for generic checkpoints; "
+            "model-zoo load_model lands with the serialization milestone")
+
+    def get_weights(self):
+        return jax.device_get(self.estimator.engine.params)

@@ -1,0 +1,3 @@
+from .neuralcf import NeuralCF, NeuralCFNet
+
+__all__ = ["NeuralCF", "NeuralCFNet"]

@@ -1,0 +1,90 @@
+"""Neural Collaborative Filtering — flax/TPU implementation.
+
+Same architecture and constructor surface as the reference's NeuralCF
+(pyzoo/zoo/models/recommendation/neuralcf.py:30-99: MLP tower over user/item
+embeddings, optional GMF branch multiplied elementwise, softmax head with
+``class_num`` classes), re-expressed as a flax module whose embeddings and
+matmuls land on the MXU. Inputs are int32 ``(batch, 2)`` [user, item] pairs —
+the same packed layout the reference feeds (Select(1,0)/Select(1,1)).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..common.zoo_model import ZooModel
+
+
+class NeuralCFNet(nn.Module):
+    user_count: int
+    item_count: int
+    class_num: int
+    user_embed: int = 20
+    item_embed: int = 20
+    hidden_layers: Tuple[int, ...] = (40, 20, 10)
+    include_mf: bool = True
+    mf_embed: int = 20
+    compute_dtype: jnp.dtype = jnp.float32
+    return_logits: bool = False
+
+    @nn.compact
+    def __call__(self, user_item: jnp.ndarray) -> jnp.ndarray:
+        ui = user_item.reshape(user_item.shape[0], 2).astype(jnp.int32)
+        user, item = ui[:, 0], ui[:, 1]
+        init = nn.initializers.uniform(scale=0.04)
+        mlp_u = nn.Embed(self.user_count + 1, self.user_embed,
+                         embedding_init=init, name="mlp_user_embed")(user)
+        mlp_i = nn.Embed(self.item_count + 1, self.item_embed,
+                         embedding_init=init, name="mlp_item_embed")(item)
+        h = jnp.concatenate([mlp_u, mlp_i], -1).astype(self.compute_dtype)
+        for k, units in enumerate(self.hidden_layers):
+            h = nn.relu(nn.Dense(units, dtype=self.compute_dtype,
+                                 name=f"mlp_dense_{k}")(h))
+        if self.include_mf:
+            mf_u = nn.Embed(self.user_count + 1, self.mf_embed,
+                            embedding_init=init, name="mf_user_embed")(user)
+            mf_i = nn.Embed(self.item_count + 1, self.mf_embed,
+                            embedding_init=init, name="mf_item_embed")(item)
+            h = jnp.concatenate(
+                [h, (mf_u * mf_i).astype(self.compute_dtype)], -1)
+        logits = nn.Dense(self.class_num, dtype=jnp.float32,
+                          name="head")(h)
+        return logits if self.return_logits else nn.softmax(logits)
+
+
+class NeuralCF(ZooModel):
+    """User-facing wrapper with the reference's constructor signature."""
+
+    def __init__(self, user_count, item_count, class_num, user_embed=20,
+                 item_embed=20, hidden_layers=(40, 20, 10), include_mf=True,
+                 mf_embed=20, compute_dtype=jnp.float32, **_):
+        self.user_count = int(user_count)
+        self.item_count = int(item_count)
+        self.class_num = int(class_num)
+        module = NeuralCFNet(
+            user_count=int(user_count), item_count=int(item_count),
+            class_num=int(class_num), user_embed=int(user_embed),
+            item_embed=int(item_embed),
+            hidden_layers=tuple(int(u) for u in hidden_layers),
+            include_mf=include_mf, mf_embed=int(mf_embed),
+            compute_dtype=compute_dtype)
+        super().__init__(module)
+
+    def recommend_for_user(self, user_item_pairs, max_items: int = 5):
+        """Rank candidate items per user from predicted click prob
+        (reference Recommender.recommend_for_user,
+        pyzoo/zoo/models/recommendation/recommender.py)."""
+        import numpy as np
+        probs = self.predict(user_item_pairs)
+        score = probs[:, -1] if probs.ndim == 2 else probs
+        users = np.asarray(user_item_pairs)[:, 0]
+        out = {}
+        for u in np.unique(users):
+            m = users == u
+            items = np.asarray(user_item_pairs)[m, 1]
+            order = np.argsort(-score[m])[:max_items]
+            out[int(u)] = [(int(items[i]), float(score[m][i])) for i in order]
+        return out

@@ -1,0 +1,3 @@
+from .estimator import Estimator, TPUEstimator
+
+__all__ = ["Estimator", "TPUEstimator"]

@@ -1,0 +1,87 @@
+"""Loss functions for estimator ``compile`` — jax equivalents of the Keras
+loss names the reference passes through to TF/BigDL (e.g. KerasEstimator's
+loss arg, pyzoo/zoo/orca/learn/tf/estimator.py:777-870). Each takes
+(y_true, y_pred) -> per-example loss; reductions happen in the train step so
+sample-weight masking composes."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-7
+
+
+def mean_squared_error(y_true, y_pred):
+    d = y_pred.reshape(y_true.shape) - y_true
+    return (d * d).reshape(d.shape[0], -1).mean(-1)
+
+
+def mean_absolute_error(y_true, y_pred):
+    d = jnp.abs(y_pred.reshape(y_true.shape) - y_true)
+    return d.reshape(d.shape[0], -1).mean(-1)
+
+
+def binary_crossentropy(y_true, y_pred, from_logits: bool = False):
+    y_pred = y_pred.reshape(y_true.shape)
+    if from_logits:
+        ll = jnp.maximum(y_pred, 0) - y_pred * y_true + jnp.log1p(
+            jnp.exp(-jnp.abs(y_pred)))
+    else:
+        p = jnp.clip(y_pred, EPS, 1 - EPS)
+        ll = -(y_true * jnp.log(p) + (1 - y_true) * jnp.log(1 - p))
+    return ll.reshape(ll.shape[0], -1).mean(-1)
+
+
+def categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, -1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, EPS, 1.0))
+    return -jnp.sum(y_true * logp, -1)
+
+
+def sparse_categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, -1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, EPS, 1.0))
+    idx = y_true.reshape(logp.shape[:-1]).astype(jnp.int32)
+    return -jnp.take_along_axis(logp, idx[..., None], -1)[..., 0]
+
+
+def hinge(y_true, y_pred):
+    return jnp.maximum(1.0 - y_true * y_pred.reshape(y_true.shape), 0.0
+                       ).reshape(y_true.shape[0], -1).mean(-1)
+
+
+def huber(y_true, y_pred, delta: float = 1.0):
+    d = jnp.abs(y_pred.reshape(y_true.shape) - y_true)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return loss.reshape(loss.shape[0], -1).mean(-1)
+
+
+def kld(y_true, y_pred):
+    t = jnp.clip(y_true, EPS, 1.0)
+    p = jnp.clip(y_pred, EPS, 1.0)
+    return jnp.sum(t * jnp.log(t / p), -1)
+
+
+_LOSSES = {
+    "mse": mean_squared_error, "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error, "mean_absolute_error": mean_absolute_error,
+    "binary_crossentropy": binary_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "hinge": hinge, "huber": huber, "kld": kld,
+}
+
+
+def convert_loss(loss) -> Callable:
+    if callable(loss):
+        return loss
+    if isinstance(loss, str) and loss.lower() in _LOSSES:
+        return _LOSSES[loss.lower()]
+    raise ValueError(f"unknown loss {loss!r}; known: {sorted(_LOSSES)}")

@@ -1,0 +1,270 @@
+"""Metric zoo — jit-friendly streaming metrics.
+
+Mirrors the reference's metric set (pyzoo/zoo/orca/learn/metrics.py:19-341:
+AUC, MAE, MSE, Accuracy, SparseCategoricalAccuracy, CategoricalAccuracy,
+BinaryAccuracy, Top5Accuracy, BinaryCrossEntropy, CategoricalCrossEntropy,
+SparseCategoricalCrossEntropy, KLDivergence, Poisson), re-designed for XLA:
+each metric is a pure (init_state, update, compute) triple whose state is a
+small pytree of arrays, so accumulation happens *inside* the jitted eval step
+and states psum cleanly across the dp axis — no driver-side reduction of
+per-record results like the reference's BigDL ValidationMethods.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-7
+
+
+class Metric:
+    """Base streaming metric. State is a dict of arrays; ``update`` must be
+    traceable and ``compute`` maps final state to a scalar."""
+
+    name: str = "metric"
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        return {"total": jnp.zeros(()), "count": jnp.zeros(())}
+
+    def update(self, state, y_true, y_pred, weight=None):
+        raise NotImplementedError
+
+    def compute(self, state):
+        return state["total"] / jnp.maximum(state["count"], EPS)
+
+    # helpers ---------------------------------------------------------------
+    @staticmethod
+    def _weighted(values, weight):
+        values = values.reshape(values.shape[0], -1).mean(axis=-1)
+        if weight is None:
+            weight = jnp.ones_like(values)
+        return jnp.sum(values * weight), jnp.sum(weight)
+
+    def _accumulate(self, state, values, weight):
+        t, c = self._weighted(values, weight)
+        return {"total": state["total"] + t, "count": state["count"] + c}
+
+
+class MAE(Metric):
+    """(reference: orca/learn/metrics.py:112)"""
+    name = "mae"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        return self._accumulate(
+            state, jnp.abs(y_pred.reshape(y_true.shape) - y_true), weight)
+
+
+class MSE(Metric):
+    """(reference: orca/learn/metrics.py:132)"""
+    name = "mse"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        d = y_pred.reshape(y_true.shape) - y_true
+        return self._accumulate(state, d * d, weight)
+
+
+class RMSE(MSE):
+    name = "rmse"
+
+    def compute(self, state):
+        return jnp.sqrt(super().compute(state))
+
+
+class Accuracy(Metric):
+    """Auto-dispatching accuracy like the reference's (metrics.py:152-181):
+    sparse labels + 2D logits -> argmax match; binary outputs -> threshold."""
+    name = "accuracy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1)
+            true = y_true if y_true.ndim < y_pred.ndim else jnp.argmax(
+                y_true, axis=-1)
+            correct = (pred == true.astype(pred.dtype)).astype(jnp.float32)
+        else:
+            p = y_pred.reshape(y_true.shape)
+            correct = ((p > 0.5) == (y_true > 0.5)).astype(jnp.float32)
+        return self._accumulate(state, correct, weight)
+
+
+class SparseCategoricalAccuracy(Metric):
+    """(reference: metrics.py:183)"""
+    name = "sparse_categorical_accuracy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        pred = jnp.argmax(y_pred, axis=-1)
+        correct = (pred == y_true.reshape(pred.shape).astype(pred.dtype))
+        return self._accumulate(state, correct.astype(jnp.float32), weight)
+
+
+class CategoricalAccuracy(Metric):
+    """(reference: metrics.py:203)"""
+    name = "categorical_accuracy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        correct = (jnp.argmax(y_pred, -1) == jnp.argmax(y_true, -1))
+        return self._accumulate(state, correct.astype(jnp.float32), weight)
+
+
+class BinaryAccuracy(Metric):
+    """(reference: metrics.py:222)"""
+    name = "binary_accuracy"
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def update(self, state, y_true, y_pred, weight=None):
+        p = y_pred.reshape(y_true.shape)
+        correct = ((p > self.threshold).astype(jnp.float32) == y_true)
+        return self._accumulate(state, correct.astype(jnp.float32), weight)
+
+
+class TopKCategoricalAccuracy(Metric):
+    def __init__(self, k: int = 5):
+        self.k = k
+        self.name = f"top{k}_accuracy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        true = y_true if y_true.ndim == y_pred.ndim - 1 else jnp.argmax(
+            y_true, -1)
+        true = true.reshape(y_pred.shape[:-1]).astype(jnp.int32)
+        _, topk = jax.lax.top_k(y_pred, self.k)
+        correct = jnp.any(topk == true[..., None], axis=-1)
+        return self._accumulate(state, correct.astype(jnp.float32), weight)
+
+
+class Top5Accuracy(TopKCategoricalAccuracy):
+    """(reference: metrics.py:241)"""
+
+    def __init__(self):
+        super().__init__(5)
+        self.name = "top5_accuracy"
+
+
+class BinaryCrossEntropy(Metric):
+    """(reference: metrics.py:264)"""
+    name = "binary_crossentropy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        p = jnp.clip(y_pred.reshape(y_true.shape), EPS, 1 - EPS)
+        ll = -(y_true * jnp.log(p) + (1 - y_true) * jnp.log(1 - p))
+        return self._accumulate(state, ll, weight)
+
+
+class CategoricalCrossEntropy(Metric):
+    """(reference: metrics.py:280)"""
+    name = "categorical_crossentropy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        p = jnp.clip(y_pred, EPS, 1.0)
+        ll = -jnp.sum(y_true * jnp.log(p), axis=-1)
+        return self._accumulate(state, ll, weight)
+
+
+class SparseCategoricalCrossEntropy(Metric):
+    """(reference: metrics.py:296)"""
+    name = "sparse_categorical_crossentropy"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        p = jnp.clip(y_pred, EPS, 1.0)
+        idx = y_true.reshape(p.shape[:-1]).astype(jnp.int32)
+        ll = -jnp.log(jnp.take_along_axis(p, idx[..., None], -1))[..., 0]
+        return self._accumulate(state, ll, weight)
+
+
+class KLDivergence(Metric):
+    """(reference: metrics.py:312)"""
+    name = "kld"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        t = jnp.clip(y_true, EPS, 1.0)
+        p = jnp.clip(y_pred, EPS, 1.0)
+        return self._accumulate(state, jnp.sum(t * jnp.log(t / p), -1), weight)
+
+
+class Poisson(Metric):
+    """(reference: metrics.py:327)"""
+    name = "poisson"
+
+    def update(self, state, y_true, y_pred, weight=None):
+        p = y_pred.reshape(y_true.shape)
+        return self._accumulate(state, p - y_true * jnp.log(p + EPS), weight)
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC via fixed-threshold confusion counts (the Keras
+    approach; replaces the reference's BigDL AUC, metrics.py:91-110, which
+    buffered all scores). ``thresholds`` buckets keep state O(T) so it psums
+    across chips."""
+
+    def __init__(self, thresholds: int = 200):
+        self.n = thresholds
+        self.name = "auc"
+
+    def init_state(self):
+        z = jnp.zeros((self.n,))
+        return {"tp": z, "fp": z, "tn": z, "fn": z}
+
+    def update(self, state, y_true, y_pred, weight=None):
+        y_pred = y_pred.reshape(-1)
+        y_true = y_true.reshape(-1).astype(jnp.float32)
+        w = jnp.ones_like(y_pred) if weight is None else weight.reshape(-1)
+        thr = jnp.linspace(0.0, 1.0, self.n)[:, None]
+        pred_pos = (y_pred[None, :] >= thr).astype(jnp.float32)
+        pos = y_true[None, :]
+        wb = w[None, :]
+        return {
+            "tp": state["tp"] + jnp.sum(pred_pos * pos * wb, -1),
+            "fp": state["fp"] + jnp.sum(pred_pos * (1 - pos) * wb, -1),
+            "fn": state["fn"] + jnp.sum((1 - pred_pos) * pos * wb, -1),
+            "tn": state["tn"] + jnp.sum((1 - pred_pos) * (1 - pos) * wb, -1),
+        }
+
+    def compute(self, state):
+        tpr = state["tp"] / jnp.maximum(state["tp"] + state["fn"], EPS)
+        fpr = state["fp"] / jnp.maximum(state["fp"] + state["tn"], EPS)
+        # thresholds ascend -> fpr/tpr descend; integrate with trapezoid rule
+        return jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+
+
+_ALIASES = {
+    "accuracy": Accuracy, "acc": Accuracy, "mae": MAE, "mse": MSE,
+    "rmse": RMSE, "auc": AUC, "top5accuracy": Top5Accuracy,
+    "top5": Top5Accuracy, "binary_accuracy": BinaryAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "binary_crossentropy": BinaryCrossEntropy,
+    "categorical_crossentropy": CategoricalCrossEntropy,
+    "sparse_categorical_crossentropy": SparseCategoricalCrossEntropy,
+    "kld": KLDivergence, "poisson": Poisson,
+}
+
+
+def convert_metric(m) -> Metric:
+    """str | Metric -> Metric (mirrors Metric.convert_metrics_list,
+    reference metrics.py:30-88)."""
+    if isinstance(m, Metric):
+        return m
+    if isinstance(m, str):
+        key = m.lower()
+        if key not in _ALIASES:
+            raise ValueError(f"unknown metric '{m}'; known: {sorted(_ALIASES)}")
+        return _ALIASES[key]()
+    raise ValueError(f"cannot convert {m!r} to a Metric")
+
+
+def convert_metrics_list(metrics) -> Dict[str, Metric]:
+    if metrics is None:
+        return {}
+    if isinstance(metrics, (str, Metric)):
+        metrics = [metrics]
+    if isinstance(metrics, dict):
+        return {name: convert_metric(m) for name, m in metrics.items()}
+    out = {}
+    for m in metrics:
+        mm = convert_metric(m)
+        out[mm.name] = mm
+    return out

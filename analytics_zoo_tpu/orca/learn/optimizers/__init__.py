@@ -1,0 +1,8 @@
+from .optimizers_impl import (SGD, Adadelta, Adagrad, Adam, Adamax,
+                              AdamWeightDecay, Ftrl, LBFGS, Optimizer,
+                              ParallelAdam, RMSprop, convert_optimizer)
+from . import schedule
+
+__all__ = ["Optimizer", "SGD", "Adam", "ParallelAdam", "AdamWeightDecay",
+           "Adagrad", "Adadelta", "Adamax", "RMSprop", "Ftrl", "LBFGS",
+           "convert_optimizer", "schedule"]

@@ -1,0 +1,199 @@
+"""Optimizer wrappers over optax — the reference's BigDL OptimMethod wrappers
+(pyzoo/zoo/orca/learn/optimizers/optimizers_impl.py:22-327: SGD, Adagrad,
+LBFGS, Adadelta, Adam, ParallelAdam, Ftrl, Adamax, RMSprop) rebuilt on optax.
+
+``ParallelAdam`` — the reference's multithreaded Adam that splits the flat
+parameter vector across executor threads — is mapped to Adam whose update is
+sharded across the mesh by the estimator (optimizer-state sharding over the
+fsdp axis does the same work the thread pool did, but on chips).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+from .schedule import Default, Scheduler
+
+
+class Optimizer:
+    """Base wrapper: ``to_optax()`` yields an optax.GradientTransformation."""
+
+    def __init__(self, lr: float, schedule: Optional[Scheduler] = None):
+        self.lr = lr
+        self.schedule = schedule or Default()
+
+    def _lr_schedule(self):
+        return self.schedule.to_optax(self.lr)
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    """(reference: optimizers_impl.py:29 — momentum/dampening/nesterov/wd)"""
+
+    def __init__(self, learningrate: float = 1e-3, momentum: float = 0.0,
+                 dampening: float = 0.0, nesterov: bool = False,
+                 weightdecay: float = 0.0, leaningrate_schedule=None, **_):
+        super().__init__(learningrate, leaningrate_schedule)
+        self.momentum, self.nesterov = momentum, nesterov
+        self.weightdecay = weightdecay
+
+    def to_optax(self):
+        tx = optax.sgd(self._lr_schedule(),
+                       momentum=self.momentum or None,
+                       nesterov=self.nesterov)
+        if self.weightdecay:
+            tx = optax.chain(optax.add_decayed_weights(self.weightdecay), tx)
+        return tx
+
+
+class Adam(Optimizer):
+    """(reference: optimizers_impl.py:174)"""
+
+    def __init__(self, lr: float = 1e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8,
+                 decay: float = 0.0, schedule=None, **_):
+        super().__init__(lr, schedule)
+        self.b1, self.b2, self.eps, self.decay = beta_1, beta_2, epsilon, decay
+
+    def to_optax(self):
+        sched = self._lr_schedule()
+        if self.decay:
+            base = sched
+            sched = lambda step: base(step) / (1.0 + self.decay * step)
+        return optax.adam(sched, b1=self.b1, b2=self.b2, eps=self.eps)
+
+
+class ParallelAdam(Adam):
+    """(reference: optimizers_impl.py:204) — parallelism comes from mesh
+    sharding, not threads; numerically identical to Adam."""
+
+
+class AdamWeightDecay(Optimizer):
+    """AdamW (the reference ships a BERT AdamWeightDecay in tfpark)."""
+
+    def __init__(self, lr: float = 1e-3, weight_decay: float = 0.01,
+                 beta_1: float = 0.9, beta_2: float = 0.999,
+                 epsilon: float = 1e-6, schedule=None, **_):
+        super().__init__(lr, schedule)
+        self.wd, self.b1, self.b2, self.eps = weight_decay, beta_1, beta_2, epsilon
+
+    def to_optax(self):
+        return optax.adamw(self._lr_schedule(), b1=self.b1, b2=self.b2,
+                           eps=self.eps, weight_decay=self.wd)
+
+
+class Adagrad(Optimizer):
+    """(reference: optimizers_impl.py:75)"""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0, weightdecay: float = 0.0, **_):
+        super().__init__(learningrate)
+        self.lr_decay, self.weightdecay = learningrate_decay, weightdecay
+
+    def to_optax(self):
+        sched = self._lr_schedule()
+        if self.lr_decay:
+            base = sched
+            sched = lambda step: base(step) / (1.0 + self.lr_decay * step)
+        tx = optax.adagrad(sched)
+        if self.weightdecay:
+            tx = optax.chain(optax.add_decayed_weights(self.weightdecay), tx)
+        return tx
+
+
+class Adadelta(Optimizer):
+    """(reference: optimizers_impl.py:152)"""
+
+    def __init__(self, decayrate: float = 0.9, epsilon: float = 1e-10, **_):
+        super().__init__(1.0)
+        self.rho, self.eps = decayrate, epsilon
+
+    def to_optax(self):
+        return optax.adadelta(self._lr_schedule(), rho=self.rho, eps=self.eps)
+
+
+class Adamax(Optimizer):
+    """(reference: optimizers_impl.py:276)"""
+
+    def __init__(self, lr: float = 2e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-38, **_):
+        super().__init__(lr)
+        self.b1, self.b2, self.eps = beta_1, beta_2, epsilon
+
+    def to_optax(self):
+        return optax.adamax(self._lr_schedule(), b1=self.b1, b2=self.b2,
+                            eps=self.eps)
+
+
+class RMSprop(Optimizer):
+    """(reference: optimizers_impl.py:303)"""
+
+    def __init__(self, lr: float = 1e-2, decayrate: float = 0.99,
+                 epsilon: float = 1e-8, **_):
+        super().__init__(lr)
+        self.decay, self.eps = decayrate, epsilon
+
+    def to_optax(self):
+        return optax.rmsprop(self._lr_schedule(), decay=self.decay,
+                             eps=self.eps)
+
+
+class Ftrl(Optimizer):
+    """(reference: optimizers_impl.py:236)"""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0, **_):
+        super().__init__(learningrate)
+        self.lr_power = learningrate_power
+        self.init_acc = initial_accumulator_value
+        self.l1, self.l2 = (l1_regularization_strength,
+                            l2_regularization_strength)
+
+    def to_optax(self):
+        try:
+            return optax.ftrl(self.lr, lambda_1=self.l1, lambda_2=self.l2,
+                              learning_rate_power=self.lr_power,
+                              initial_accumulator_value=self.init_acc)
+        except AttributeError:
+            # older optax: fall back to adagrad + l1/l2 penalty
+            tx = optax.adagrad(self.lr,
+                               initial_accumulator_value=self.init_acc)
+            if self.l2:
+                tx = optax.chain(optax.add_decayed_weights(self.l2), tx)
+            return tx
+
+
+class LBFGS(Optimizer):
+    """(reference: optimizers_impl.py:99) — second-order; optax provides
+    optax.lbfgs. Intended for small full-batch problems."""
+
+    def __init__(self, max_iter: int = 20, learningrate: float = 1.0, **_):
+        super().__init__(learningrate)
+        self.max_iter = max_iter
+
+    def to_optax(self):
+        return optax.lbfgs(self.lr)
+
+
+def convert_optimizer(opt) -> optax.GradientTransformation:
+    """Optimizer | optax transform | str -> optax transform."""
+    if isinstance(opt, Optimizer):
+        return opt.to_optax()
+    if isinstance(opt, optax.GradientTransformation):
+        return opt
+    if isinstance(opt, str):
+        table = {"sgd": SGD, "adam": Adam, "adagrad": Adagrad,
+                 "adadelta": Adadelta, "adamax": Adamax, "rmsprop": RMSprop,
+                 "ftrl": Ftrl, "adamw": AdamWeightDecay}
+        key = opt.lower()
+        if key not in table:
+            raise ValueError(f"unknown optimizer '{opt}'")
+        return table[key]().to_optax()
+    raise ValueError(f"cannot convert {opt!r} to an optimizer")

@@ -1,0 +1,165 @@
+"""LR schedules — optax-backed equivalents of the reference's BigDL schedule
+wrappers (pyzoo/zoo/orca/learn/optimizers/schedule.py:19-216: Poly, Exponential,
+Step, Default, Plateau, Warmup, MultiStep, SequentialSchedule). Each object
+builds an ``optax`` schedule function (step -> lr multiplier or absolute lr);
+``SequentialSchedule`` is optax.join_schedules, ``Warmup`` is linear warmup.
+Plateau (metric-driven) cannot live inside jit; it is applied between epochs
+by the estimator via the ``on_epoch_end`` hook."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import optax
+
+
+class Scheduler:
+    """Base: subclasses produce an optax schedule via ``to_optax(base_lr)``."""
+
+    def to_optax(self, base_lr: float):
+        raise NotImplementedError
+
+    def jit_compatible(self) -> bool:
+        return True
+
+
+class Default(Scheduler):
+    """Constant lr (reference: schedule.py:89)."""
+
+    def to_optax(self, base_lr: float):
+        return optax.constant_schedule(base_lr)
+
+
+class Poly(Scheduler):
+    """lr = base * (1 - iter/max_iteration)^power (reference: schedule.py:26)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def to_optax(self, base_lr: float):
+        return optax.polynomial_schedule(
+            init_value=base_lr, end_value=0.0, power=self.power,
+            transition_steps=self.max_iteration)
+
+
+class Exponential(Scheduler):
+    """(reference: schedule.py:47)"""
+
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False):
+        self.decay_step, self.decay_rate = decay_step, decay_rate
+        self.stair_case = stair_case
+
+    def to_optax(self, base_lr: float):
+        return optax.exponential_decay(
+            init_value=base_lr, transition_steps=self.decay_step,
+            decay_rate=self.decay_rate, staircase=self.stair_case)
+
+
+class Step(Scheduler):
+    """lr decayed by gamma every step_size (reference: schedule.py:67)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def to_optax(self, base_lr: float):
+        return optax.exponential_decay(
+            init_value=base_lr, transition_steps=self.step_size,
+            decay_rate=self.gamma, staircase=True)
+
+
+class MultiStep(Scheduler):
+    """(reference: schedule.py:167)"""
+
+    def __init__(self, step_sizes: Sequence[int], gamma: float):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def to_optax(self, base_lr: float):
+        boundaries = {s: self.gamma for s in self.step_sizes}
+        return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+class Warmup(Scheduler):
+    """Linear lr increase by ``delta`` per step (reference: schedule.py:147).
+    Used inside SequentialSchedule; standalone it warms from 0."""
+
+    def __init__(self, delta: float, steps: Optional[int] = None):
+        self.delta, self.steps = delta, steps
+
+    def to_optax(self, base_lr: float):
+        steps = self.steps if self.steps is not None else 1
+        return optax.linear_schedule(
+            init_value=base_lr, end_value=base_lr + self.delta * steps,
+            transition_steps=steps)
+
+
+class SequentialSchedule(Scheduler):
+    """Chain schedules, each active for ``iteration_per_schedule`` steps
+    (reference: schedule.py:188-216: add(scheduler, max_iteration))."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.iteration_per_epoch = iteration_per_epoch
+        self._entries: List = []
+
+    def add(self, scheduler: Scheduler, max_iteration: int
+            ) -> "SequentialSchedule":
+        self._entries.append((scheduler, max_iteration))
+        return self
+
+    def to_optax(self, base_lr: float):
+        if not self._entries:
+            return optax.constant_schedule(base_lr)
+        schedules, boundaries, acc = [], [], 0
+        for sched, n in self._entries:
+            if isinstance(sched, Warmup) and sched.steps is None:
+                sched = Warmup(sched.delta, n)
+            schedules.append(sched.to_optax(base_lr))
+            acc += n
+            boundaries.append(acc)
+        return optax.join_schedules(schedules, boundaries[:-1])
+
+
+class Plateau(Scheduler):
+    """Reduce-on-plateau (reference: schedule.py:109). Metric-driven, so it
+    runs host-side between validation runs; the estimator multiplies a
+    host-held lr scale that feeds the jitted step as an argument."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        assert mode in ("min", "max")
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon, self.cooldown = mode, epsilon, cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._wait = 0
+        self._cooling = 0
+        self.scale = 1.0
+
+    def jit_compatible(self) -> bool:
+        return False
+
+    def to_optax(self, base_lr: float):
+        return optax.constant_schedule(base_lr)
+
+    def on_metric(self, value: float, base_lr: float) -> float:
+        """Update internal state with a new monitored value; returns the lr
+        scale to apply."""
+        better = (self._best is None or
+                  (self.mode == "min" and value < self._best - self.epsilon) or
+                  (self.mode == "max" and value > self._best + self.epsilon))
+        if self._cooling > 0:
+            self._cooling -= 1
+            self._wait = 0
+        if better:
+            self._best = value
+            self._wait = 0
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                new_scale = max(self.scale * self.factor,
+                                self.min_lr / max(base_lr, 1e-12))
+                self.scale = new_scale
+                self._cooling = self.cooldown
+                self._wait = 0
+        return self.scale

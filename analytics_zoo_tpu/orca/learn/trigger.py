@@ -1,0 +1,104 @@
+"""Triggers controlling when checkpoints/validation fire.
+
+Mirrors the reference's trigger set (pyzoo/zoo/orca/learn/trigger.py:19-77 and
+pyzoo/zoo/util/triggers.py:20-186: EveryEpoch, SeveralIteration, MaxEpoch,
+MaxIteration, MaxScore, MinLoss, TriggerAnd, TriggerOr) as plain host-side
+predicates over a TrainingState snapshot — no JVM ZooTrigger objects."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class TrainerState:
+    epoch: int = 0           # completed epochs
+    iteration: int = 0       # completed global steps
+    epoch_finished: bool = False
+    loss: Optional[float] = None
+    score: Optional[float] = None
+    records_processed: int = 0
+
+
+class Trigger:
+    def __call__(self, state: TrainerState) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def convert_trigger(t) -> "Trigger":
+        if isinstance(t, Trigger):
+            return t
+        if isinstance(t, str):
+            if t == "every_epoch":
+                return EveryEpoch()
+            raise ValueError(f"unknown trigger '{t}'")
+        raise ValueError(f"cannot convert {t!r} to a Trigger")
+
+
+class EveryEpoch(Trigger):
+    """Fires at each epoch boundary (reference: trigger.py:40)."""
+
+    def __call__(self, state):
+        return state.epoch_finished
+
+
+class SeveralIteration(Trigger):
+    """Fires every N iterations (reference: trigger.py:59)."""
+
+    def __init__(self, interval: int):
+        self.interval = int(interval)
+
+    def __call__(self, state):
+        return state.iteration > 0 and state.iteration % self.interval == 0
+
+
+class MaxEpoch(Trigger):
+    """End-trigger: true once `max` epochs completed (reference:
+    util/triggers.py MaxEpoch)."""
+
+    def __init__(self, max: int):
+        self.max = int(max)
+
+    def __call__(self, state):
+        return state.epoch >= self.max
+
+
+class MaxIteration(Trigger):
+    def __init__(self, max: int):
+        self.max = int(max)
+
+    def __call__(self, state):
+        return state.iteration >= self.max
+
+
+class MaxScore(Trigger):
+    def __init__(self, max: float):
+        self.max = float(max)
+
+    def __call__(self, state):
+        return state.score is not None and state.score > self.max
+
+
+class MinLoss(Trigger):
+    def __init__(self, min: float):
+        self.min = float(min)
+
+    def __call__(self, state):
+        return state.loss is not None and state.loss < self.min
+
+
+class TriggerAnd(Trigger):
+    def __init__(self, first: Trigger, *others: Trigger):
+        self.triggers = (first,) + others
+
+    def __call__(self, state):
+        return all(t(state) for t in self.triggers)
+
+
+class TriggerOr(Trigger):
+    def __init__(self, first: Trigger, *others: Trigger):
+        self.triggers = (first,) + others
+
+    def __call__(self, state):
+        return any(t(state) for t in self.triggers)

@@ -1,0 +1,243 @@
+"""Input-pipeline utilities: convert user data (dict-of-ndarray, XShards,
+pandas shards, creator functions) into padded, mesh-sharded device batches.
+
+Replaces the reference's per-backend data plumbing: arrays2dict/
+dataframe_to_xshards (pyzoo/zoo/orca/learn/utils.py:191-311), TFDataset
+per-core batching (pyzoo/zoo/tfpark/tf_dataset.py:117-160), and the Ray
+LocalStore shuttle (pyzoo/zoo/orca/data/ray_xshards.py:67-94). TPU rule: the
+global batch is sharded on the mesh's data axes; ragged tails are padded and
+masked with a per-example weight so no record is dropped and no shape is
+dynamic (SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...utils import nest
+from ..data.shard import HostXShards
+
+
+@dataclass
+class Batch:
+    """One global batch: tuples of feature/label arrays plus a mask weight."""
+    x: Tuple[np.ndarray, ...]
+    y: Optional[Tuple[np.ndarray, ...]]
+    w: np.ndarray  # (batch,) 1.0 for real rows, 0.0 for padding
+
+
+def _as_tuple(v) -> Tuple:
+    if v is None:
+        return ()
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,)
+
+
+def xshards_from_arrays(data: Any, feature_cols=None, label_cols=None,
+                        num_shards: Optional[int] = None) -> HostXShards:
+    """Normalize any supported input into XShards of {'x': tuple, 'y': tuple}."""
+    if isinstance(data, HostXShards):
+        return normalize_xshards(data, feature_cols, label_cols)
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            data = HostXShards([data])
+            return normalize_xshards(data, feature_cols, label_cols)
+    except ImportError:
+        pass
+    if isinstance(data, dict):
+        x, y = data.get("x"), data.get("y")
+    elif isinstance(data, tuple) and len(data) == 2:
+        x, y = data
+    else:
+        x, y = data, None
+    shard = {"x": _as_tuple(x)}
+    if y is not None:
+        shard["y"] = _as_tuple(y)
+    n = num_shards or 1
+    flat_len = len(nest.flatten(shard)[0])
+    n = min(n, max(flat_len, 1))
+    return HostXShards([_slice_dict(shard, idx)
+                        for idx in np.array_split(np.arange(flat_len), n)])
+
+
+def _slice_dict(shard: Dict, idx: np.ndarray) -> Dict:
+    out = {}
+    for k, v in shard.items():
+        out[k] = tuple(np.asarray(a)[idx] for a in v)
+    return out
+
+
+def normalize_xshards(shards: HostXShards, feature_cols=None,
+                      label_cols=None) -> HostXShards:
+    """Map pandas-DataFrame or raw-dict shards to {'x': tuple, 'y': tuple}
+    (the reference's process_xshards_of_pandas_dataframe,
+    orca/learn/utils.py:253-264)."""
+    first = shards.collect()[0] if shards.num_partitions() else None
+
+    def from_df(df):
+        x = tuple(df[c].to_numpy() for c in feature_cols)
+        out = {"x": x}
+        if label_cols:
+            out["y"] = tuple(df[c].to_numpy() for c in label_cols)
+        return out
+
+    def from_dict(d):
+        out = {"x": _as_tuple(d["x"])}
+        if "y" in d and d["y"] is not None:
+            out["y"] = _as_tuple(d["y"])
+        return out
+
+    try:
+        import pandas as pd
+        if isinstance(first, pd.DataFrame):
+            if not feature_cols:
+                raise ValueError(
+                    "feature_cols is required for pandas-DataFrame XShards")
+            return shards.transform_shard(from_df)
+    except ImportError:
+        pass
+    if isinstance(first, dict):
+        return shards.transform_shard(from_dict)
+    raise ValueError(f"unsupported shard element type {type(first)}")
+
+
+def concat_shards(shards: HostXShards) -> Dict[str, Tuple[np.ndarray, ...]]:
+    parts = shards.collect()
+    if not parts:
+        raise ValueError("empty XShards")
+    keys = parts[0].keys()
+    out = {}
+    for k in keys:
+        n = len(parts[0][k])
+        out[k] = tuple(
+            np.concatenate([np.asarray(p[k][i]) for p in parts])
+            for i in range(n))
+    return out
+
+
+class BatchIterator:
+    """Epoch iterator over host-local data producing padded global batches.
+
+    The per-host arrays are treated as this process's stripe of the global
+    dataset; ``batch_size`` is the *global* batch (the reference's TFDataset
+    batch semantics, tf_dataset.py:135-149), so each host contributes
+    batch_size / process_count rows per step.
+    """
+
+    def __init__(self, data: Dict[str, Tuple[np.ndarray, ...]],
+                 batch_size: int, mesh: Mesh, shuffle: bool = False,
+                 seed: int = 0, pad_tail: bool = True):
+        self.x = data["x"]
+        self.y = data.get("y")
+        self.n = len(self.x[0])
+        self.mesh = mesh
+        nproc = jax.process_count()
+        if batch_size % (nproc or 1):
+            raise ValueError(
+                f"global batch_size {batch_size} must divide across "
+                f"{nproc} processes")
+        self.local_bs = max(batch_size // max(nproc, 1), 1)
+        # The sharded leading dim must divide by the local share of the data
+        # axes (the reference instead hard-errors on batch % node*core != 0,
+        # tf_dataset.py:135-149; padding+masking is strictly more permissive).
+        data_axis = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        local_div = max(data_axis // max(nproc, 1), 1)
+        if self.local_bs % local_div:
+            self.local_bs = math.ceil(self.local_bs / local_div) * local_div
+        self.global_bs = self.local_bs * max(nproc, 1)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.pad_tail = pad_tail
+        self.steps_per_epoch = (
+            math.ceil(self.n / self.local_bs) if pad_tail
+            else self.n // self.local_bs)
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"dataset has {self.n} rows < local batch {self.local_bs}")
+        self._epoch = 0
+        self._sharding_cache: Dict[int, NamedSharding] = {}
+
+    def _sharding(self, ndim: int) -> NamedSharding:
+        if ndim not in self._sharding_cache:
+            spec = (("dp", "fsdp"),) + (None,) * (ndim - 1)
+            self._sharding_cache[ndim] = NamedSharding(self.mesh, P(*spec))
+        return self._sharding_cache[ndim]
+
+    def _device_put(self, arr: np.ndarray):
+        sh = self._sharding(arr.ndim)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sh, arr)
+        return jax.device_put(arr, sh)
+
+    def epoch(self, shuffle: Optional[bool] = None) -> Iterator[Batch]:
+        shuffle = self.shuffle if shuffle is None else shuffle
+        order = np.arange(self.n)
+        if shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            rng.shuffle(order)
+        self._epoch += 1
+        for s in range(self.steps_per_epoch):
+            idx = order[s * self.local_bs:(s + 1) * self.local_bs]
+            real = len(idx)
+            if real < self.local_bs:
+                idx = np.concatenate(
+                    [idx, np.zeros(self.local_bs - real, dtype=idx.dtype)])
+            w = np.zeros(self.local_bs, dtype=np.float32)
+            w[:real] = 1.0
+            xs = tuple(self._device_put(np.asarray(a)[idx]) for a in self.x)
+            ys = (tuple(self._device_put(np.asarray(a)[idx]) for a in self.y)
+                  if self.y is not None else None)
+            yield Batch(x=xs, y=ys, w=self._device_put(w))
+
+
+def data_to_iterator(data: Any, batch_size: int, mesh: Mesh,
+                     feature_cols=None, label_cols=None, shuffle=False,
+                     seed: int = 0, pad_tail: bool = True,
+                     config: Optional[dict] = None) -> BatchIterator:
+    """Front door: any supported data form -> BatchIterator."""
+    if callable(data):  # data_creator(config, batch_size) like tf2/pytorch est.
+        produced = data(config or {}, batch_size)
+        return data_to_iterator(produced, batch_size, mesh, feature_cols,
+                                label_cols, shuffle, seed, pad_tail)
+    shards = xshards_from_arrays(data, feature_cols, label_cols)
+    merged = concat_shards(shards)
+    return BatchIterator(merged, batch_size, mesh, shuffle=shuffle, seed=seed,
+                         pad_tail=pad_tail)
+
+
+def update_predict_xshards(xshards: HostXShards,
+                           pred_shards: HostXShards) -> HostXShards:
+    """Attach predictions to the original shards (reference:
+    orca/learn/utils.py:116-125)."""
+    def merge(pair):
+        d, pred = pair
+        out = dict(d) if isinstance(d, dict) else {"x": d}
+        out["prediction"] = pred
+        return out
+    return xshards.zip(pred_shards).transform_shard(merge)
+
+
+def find_latest_checkpoint(model_dir: str, model_type: str = "tpu"):
+    """Locate the newest versioned checkpoint under model_dir (reference:
+    orca/learn/utils.py:24-69 scans for model.<iter> files; here orbax step
+    dirs)."""
+    import os
+    import re
+    if not os.path.isdir(model_dir):
+        return None, None
+    best = (None, -1)
+    for name in os.listdir(model_dir):
+        m = re.fullmatch(r"(?:ckpt-|step_)?(\d+)", name)
+        if m and os.path.isdir(os.path.join(model_dir, name)):
+            v = int(m.group(1))
+            if v > best[1]:
+                best = (os.path.join(model_dir, name), v)
+    return best if best[0] else (None, None)
