@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Benchmark: NCF-MovieLens training throughput on TPU (BASELINE config #1).
+
+Trains the flagship NeuralCF model (MovieLens-1M scale: 6040 users, 3706
+items, reference app apps/recommendation-ncf/ncf-explicit-feedback.ipynb) with
+the unified Orca estimator engine and reports steady-state training
+samples/sec on the attached chip.
+
+Baseline: the reference publishes no absolute numbers (BASELINE.md); the
+north-star target is >=0.8x Horovod-on-8xA100 per-chip throughput. MLPerf-era
+NCF runs reach ~60M samples/sec on a DGX-1 (8xV100); scaling ~2x for A100
+gives ~120M/8 = 15M samples/sec/chip as the comparison constant.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 15_000_000.0
+
+
+def main():
+    import jax
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.orca.learn.optimizers import Adam
+
+    init_orca_context("local")
+
+    n_users, n_items = 6040, 3706
+    batch = 16384
+    steps_measured = 50
+
+    rng = np.random.RandomState(0)
+    n = batch * 4
+    pairs = np.stack([rng.randint(1, n_users, n),
+                      rng.randint(1, n_items, n)], -1).astype(np.int32)
+    ratings = rng.randint(0, 5, n).astype(np.int32)
+
+    import jax.numpy as jnp
+    model = NeuralCF(user_count=n_users, item_count=n_items, class_num=5,
+                     user_embed=64, item_embed=64, hidden_layers=(128, 64, 32),
+                     mf_embed=64, compute_dtype=jnp.bfloat16)
+    model.compile(loss="sparse_categorical_crossentropy",
+                  optimizer=Adam(lr=1e-3), metrics=None)
+    est = model.estimator
+
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+    it = data_to_iterator({"x": pairs, "y": ratings}, batch, est.ctx.mesh,
+                          shuffle=False)
+    batches = list(it.epoch())
+    est.engine.build((pairs[:1],))
+
+    # warmup/compile
+    for b in batches[:2]:
+        est.engine.train_batch(b)
+    jax.block_until_ready(est.engine.params)
+
+    t0 = time.perf_counter()
+    done = 0
+    while done < steps_measured:
+        for b in batches:
+            est.engine.train_batch(b)
+            done += 1
+            if done >= steps_measured:
+                break
+    jax.block_until_ready(est.engine.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps_measured * batch / dt
+    per_chip = samples_per_sec / max(jax.device_count(), 1)
+    print(json.dumps({
+        "metric": "ncf_movielens_train_throughput_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
