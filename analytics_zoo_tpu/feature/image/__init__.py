@@ -1,0 +1,13 @@
+from .imageset import ImageSet
+from .preprocessing import (ChainedPreprocessing, ImageAspectScale,
+                            ImageCenterCrop, ImageChannelNormalize, ImageHFlip,
+                            ImageMatToTensor, ImagePixelNormalizer,
+                            ImageRandomCrop, ImageRandomPreprocessing,
+                            ImageResize, ImageSetToSample, Preprocessing,
+                            imagenet_train_transforms, imagenet_val_transforms)
+
+__all__ = ["ImageSet", "Preprocessing", "ChainedPreprocessing", "ImageResize",
+           "ImageAspectScale", "ImageCenterCrop", "ImageRandomCrop",
+           "ImageHFlip", "ImageChannelNormalize", "ImagePixelNormalizer",
+           "ImageRandomPreprocessing", "ImageMatToTensor", "ImageSetToSample",
+           "imagenet_train_transforms", "imagenet_val_transforms"]

@@ -1,0 +1,108 @@
+"""ImageSet — distributed image collections on XShards.
+
+Mirrors the reference's ImageSet (pyzoo/zoo/feature/image/imageset.py:21:
+read/transform/get_image/get_label; Scala zoo/.../feature/image/ImageSet.scala:370
+with LocalImageSet/DistributedImageSet): here an ImageSet wraps an XShards of
+sample dicts {'image': HWC uint8, 'label': optional, 'uri': path}, decoded with
+cv2 on the host thread pool, and feeds the estimator via to_dataset().
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...orca.data.shard import HostXShards, _pmap
+from .preprocessing import ImageSetToSample, Preprocessing
+
+_IMG_EXT = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def _list_images(path: str) -> List[str]:
+    if os.path.isdir(path):
+        out = sorted(p for p in _glob.glob(os.path.join(path, "**", "*"),
+                                           recursive=True)
+                     if p.lower().endswith(_IMG_EXT))
+    else:
+        out = sorted(_glob.glob(path))
+    if not out:
+        raise FileNotFoundError(f"no images under {path}")
+    import jax
+    pid, n = jax.process_index(), jax.process_count()
+    return out[pid::n] if n > 1 else out
+
+
+class ImageSet:
+    def __init__(self, shards: HostXShards):
+        self.shards = shards
+
+    @classmethod
+    def read(cls, path: str, with_label: bool = False,
+             one_based_label: bool = True,
+             num_partitions: Optional[int] = None) -> "ImageSet":
+        """Read images from a directory (label = parent dir name when
+        with_label, as the reference's ImageSet.read label mode)."""
+        paths = _list_images(path)
+
+        label_map = {}
+        if with_label:
+            classes = sorted({os.path.basename(os.path.dirname(p))
+                              for p in paths})
+            base = 1 if one_based_label else 0
+            label_map = {c: i + base for i, c in enumerate(classes)}
+
+        def load(p):
+            import cv2
+            img = cv2.imread(p, cv2.IMREAD_COLOR)
+            if img is None:
+                raise IOError(f"cannot decode image {p}")
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+            sample = {"image": img, "uri": p}
+            if with_label:
+                sample["label"] = np.int32(
+                    label_map[os.path.basename(os.path.dirname(p))])
+            return sample
+
+        samples = _pmap(load, paths)
+        n = num_partitions or max(1, min(len(samples), os.cpu_count() or 4))
+        chunks = np.array_split(np.arange(len(samples)), n)
+        shards = HostXShards([[samples[i] for i in idx] for idx in chunks
+                              if len(idx)])
+        obj = cls(shards)
+        obj.label_map = label_map
+        return obj
+
+    @classmethod
+    def from_arrays(cls, images: np.ndarray, labels=None,
+                    num_partitions: int = 1) -> "ImageSet":
+        samples = []
+        for i in range(len(images)):
+            s = {"image": images[i]}
+            if labels is not None:
+                s["label"] = labels[i]
+            samples.append(s)
+        chunks = np.array_split(np.arange(len(samples)), num_partitions)
+        return cls(HostXShards([[samples[i] for i in idx] for idx in chunks]))
+
+    def transform(self, transformer: Preprocessing) -> "ImageSet":
+        return ImageSet(self.shards.transform_shard(
+            lambda part: [transformer.apply(s) for s in part]))
+
+    def get_image(self) -> List[np.ndarray]:
+        return [s["image"] for part in self.shards.collect() for s in part]
+
+    def get_label(self) -> List:
+        return [s.get("label") for part in self.shards.collect() for s in part]
+
+    def to_dataset(self, with_label: bool = True) -> HostXShards:
+        """Stack each partition into the estimator's {'x','y'} arrays."""
+        def stack(part):
+            xs = np.stack([s["image"] for s in part]).astype(np.float32)
+            out = {"x": (xs,)}
+            if with_label and "label" in part[0]:
+                out["y"] = (np.asarray([s["label"] for s in part]),)
+            return out
+        return self.shards.transform_shard(stack)

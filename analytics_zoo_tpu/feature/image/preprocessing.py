@@ -1,0 +1,223 @@
+"""Image preprocessing transforms over numpy/cv2 — the host-side stage of the
+infeed pipeline.
+
+Mirrors the reference's OpenCV-on-JVM transform set
+(pyzoo/zoo/feature/image/imagePreprocessing.py: ImageResize, ImageCenterCrop,
+ImageRandomCrop, ImageChannelNormalize, ImageHFlip, ImageMatToTensor,
+ImageSetToSample; Scala twins under zoo/.../feature/image/). Transforms run on
+the host CPU over uint8/float32 numpy arrays (HWC); the padded, batched result
+is what streams into HBM — on TPU you never put per-image control flow on
+device.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Preprocessing:
+    """Chainable transform: sample dict -> sample dict. Compose with ``->``
+    semantics of the reference's ChainedPreprocessing via ``chain`` or ``|``."""
+
+    def apply(self, sample: dict) -> dict:
+        raise NotImplementedError
+
+    def __call__(self, samples):
+        if isinstance(samples, dict):
+            return self.apply(samples)
+        return [self.apply(s) for s in samples]
+
+    def __or__(self, other: "Preprocessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing([self, other])
+
+
+class ChainedPreprocessing(Preprocessing):
+    """(reference: pyzoo/zoo/feature/common.py ChainedPreprocessing)"""
+
+    def __init__(self, transforms: Sequence[Preprocessing]):
+        self.transforms = list(transforms)
+
+    def apply(self, sample):
+        for t in self.transforms:
+            sample = t.apply(sample)
+        return sample
+
+    def __or__(self, other):
+        return ChainedPreprocessing(self.transforms + [other])
+
+
+class ImageTransform(Preprocessing):
+    key = "image"
+
+    def transform_image(self, img: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def apply(self, sample):
+        out = dict(sample)
+        out[self.key] = self.transform_image(sample[self.key])
+        return out
+
+
+class ImageResize(ImageTransform):
+    """(reference: imagePreprocessing.py ImageResize)"""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def transform_image(self, img):
+        import cv2
+        return cv2.resize(img, (self.w, self.h),
+                          interpolation=cv2.INTER_LINEAR)
+
+
+class ImageAspectScale(ImageTransform):
+    """Resize preserving aspect so the short side == ``scale``
+    (reference: ImageAspectScale)."""
+
+    def __init__(self, scale: int, max_size: int = 1000):
+        self.scale, self.max_size = scale, max_size
+
+    def transform_image(self, img):
+        import cv2
+        h, w = img.shape[:2]
+        ratio = self.scale / min(h, w)
+        if round(ratio * max(h, w)) > self.max_size:
+            ratio = self.max_size / max(h, w)
+        return cv2.resize(img, (int(w * ratio), int(h * ratio)),
+                          interpolation=cv2.INTER_LINEAR)
+
+
+class ImageCenterCrop(ImageTransform):
+    """(reference: ImageCenterCrop)"""
+
+    def __init__(self, crop_height: int, crop_width: int):
+        self.ch, self.cw = crop_height, crop_width
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        top = max((h - self.ch) // 2, 0)
+        left = max((w - self.cw) // 2, 0)
+        return img[top:top + self.ch, left:left + self.cw]
+
+
+class ImageRandomCrop(ImageTransform):
+    """(reference: ImageRandomCrop)"""
+
+    def __init__(self, crop_height: int, crop_width: int,
+                 rng: Optional[random.Random] = None):
+        self.ch, self.cw = crop_height, crop_width
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        top = self.rng.randint(0, max(h - self.ch, 0))
+        left = self.rng.randint(0, max(w - self.cw, 0))
+        return img[top:top + self.ch, left:left + self.cw]
+
+
+class ImageHFlip(ImageTransform):
+    """(reference: ImageHFlip; random when p<1)"""
+
+    def __init__(self, p: float = 0.5, rng: Optional[random.Random] = None):
+        self.p = p
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        if self.rng.random() < self.p:
+            return np.ascontiguousarray(img[:, ::-1])
+        return img
+
+
+class ImageChannelNormalize(ImageTransform):
+    """Subtract per-channel mean, divide std (reference:
+    ImageChannelNormalize(mean_r, mean_g, mean_b, std_r, std_g, std_b))."""
+
+    def __init__(self, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+
+    def transform_image(self, img):
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+class ImagePixelNormalizer(ImageTransform):
+    """(reference: ImagePixelNormalizer — per-pixel mean image)"""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform_image(self, img):
+        return img.astype(np.float32) - self.means
+
+
+class ImageRandomPreprocessing(Preprocessing):
+    """Apply inner transform with probability p (reference:
+    ImageRandomPreprocessing)."""
+
+    def __init__(self, preprocessing: Preprocessing, prob: float,
+                 rng: Optional[random.Random] = None):
+        self.inner = preprocessing
+        self.prob = prob
+        self.rng = rng or random.Random()
+
+    def apply(self, sample):
+        if self.rng.random() < self.prob:
+            return self.inner.apply(sample)
+        return sample
+
+
+class ImageMatToTensor(ImageTransform):
+    """Layout/dtype finalization. TPU-native default is NHWC float32 (the
+    reference's MatToTensor emits CHW for BigDL; pass format='NCHW' for that)."""
+
+    def __init__(self, to_chw: bool = False, format: str = "NHWC"):
+        self.to_chw = to_chw or format.upper() == "NCHW"
+
+    def transform_image(self, img):
+        img = img.astype(np.float32)
+        if self.to_chw:
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+
+class ImageSetToSample(Preprocessing):
+    """Pick feature/label keys into the estimator's {'x','y'} contract
+    (reference: ImageSetToSample(input_keys, target_keys))."""
+
+    def __init__(self, input_keys=("image",), target_keys=None):
+        self.input_keys = tuple(input_keys)
+        self.target_keys = tuple(target_keys) if target_keys else None
+
+    def apply(self, sample):
+        out = {"x": tuple(sample[k] for k in self.input_keys)}
+        if self.target_keys:
+            out["y"] = tuple(sample[k] for k in self.target_keys)
+        return out
+
+
+def imagenet_train_transforms(image_size: int = 224,
+                              seed: Optional[int] = None
+                              ) -> ChainedPreprocessing:
+    """The reference ResNet-50 train pipeline (resnet-50-imagenet.py:44-230:
+    random-resized-crop + flip + normalize), as host transforms."""
+    rng = random.Random(seed)
+    return ChainedPreprocessing([
+        ImageAspectScale(256),
+        ImageRandomCrop(image_size, image_size, rng=rng),
+        ImageHFlip(0.5, rng=rng),
+        ImageChannelNormalize(123.68, 116.779, 103.939,
+                              58.393, 57.12, 57.375),
+    ])
+
+
+def imagenet_val_transforms(image_size: int = 224) -> ChainedPreprocessing:
+    return ChainedPreprocessing([
+        ImageAspectScale(256),
+        ImageCenterCrop(image_size, image_size),
+        ImageChannelNormalize(123.68, 116.779, 103.939,
+                              58.393, 57.12, 57.375),
+    ])

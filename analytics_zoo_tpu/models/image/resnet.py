@@ -1,0 +1,117 @@
+"""ResNet v1.5 family in flax — the training workload behind BASELINE config
+#2 and the reference's ResNet-50 ImageNet example
+(pyzoo/zoo/examples/orca/learn/tf2/resnet/resnet-50-imagenet.py:287-412 builds
+tf.keras ResNet50 under MultiWorkerMirroredStrategy; Scala twin at
+zoo/.../examples/resnet/TrainImageNet.scala).
+
+TPU-first details:
+* NHWC layout, bf16 compute / f32 params and BN stats — convs tile onto the
+  MXU at full rate.
+* BatchNorm without a named axis: under jit-with-sharding the batch mean IS a
+  global mean (XLA inserts the cross-chip reduction), so sync-BN across the dp
+  axis comes for free — no SyncBatchNorm machinery like GPU stacks need.
+* Identity shortcuts use projection only on shape change (v1.5: stride in the
+  3x3 conv).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 use_bias=False, name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 use_bias=False, name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    return_logits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, dtype=self.compute_dtype, param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.compute_dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 use_bias=False, name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, strides=strides,
+                                   conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="head")(x)
+        return x if self.return_logits else nn.softmax(x)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3),
+                    block_cls=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3),
+                    block_cls=BottleneckBlock)
+
+
+def resnet(depth: int = 50, num_classes: int = 1000, **kwargs) -> ResNet:
+    table = {18: ResNet18, 34: ResNet34, 50: ResNet50, 101: ResNet101,
+             152: ResNet152}
+    if depth not in table:
+        raise ValueError(f"unsupported resnet depth {depth}")
+    return table[depth](num_classes=num_classes, **kwargs)
