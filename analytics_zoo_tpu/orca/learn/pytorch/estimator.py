@@ -1,0 +1,198 @@
+"""Orca PyTorch estimator — creator-function surface on the TPU engine.
+
+Mirrors ``Estimator.from_torch`` (reference: pyzoo/zoo/orca/learn/pytorch/
+estimator.py:38; Ray path pytorch_ray_estimator.py:90-185 with model_creator/
+optimizer_creator/loss_creator/scheduler_creator and TrainingOperator hooks).
+Three reference backends (bigdl-JEP, torch_distributed DDP-gloo, horovod)
+collapse into the one jitted engine; ``backend`` is accepted and ignored
+except to reject truly unsupported requests.
+
+Two creator styles:
+* creators returning torch objects (nn.Module / torch.optim / torch losses):
+  converted to flax+optax via torch_bridge (standard layer stacks; weights
+  imported) — custom forward() raises with porting guidance;
+* creators returning jax objects (flax module / optax tx / loss callable):
+  used directly — the recommended TPU-native style.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..estimator import TPUEstimator
+from .torch_bridge import (build_flax_from_torch, convert_torch_loss,
+                           convert_torch_optimizer)
+
+
+def _is_torch_module(obj) -> bool:
+    try:
+        import torch.nn as tnn
+        return isinstance(obj, tnn.Module) and not isinstance(
+            obj, tnn.modules.loss._Loss)
+    except ImportError:
+        return False
+
+
+class Estimator:
+    @staticmethod
+    def from_torch(*, model_creator: Callable,
+                   optimizer_creator: Optional[Callable] = None,
+                   loss_creator: Optional[Callable] = None,
+                   scheduler_creator: Optional[Callable] = None,
+                   training_operator_cls=None,
+                   config: Optional[dict] = None,
+                   backend: str = "tpu",
+                   metrics=None, model_dir: Optional[str] = None,
+                   workers_per_node: int = 1, use_tqdm: bool = False,
+                   scheduler_step_freq: str = "batch", sync_stats: bool = True,
+                   log_level=None, **_):
+        if backend in ("horovod",):
+            # horovod's only role was allreduce; the engine does that over ICI
+            pass
+        cfg = dict(config or {})
+        model = model_creator(cfg)
+        loss = loss_creator(cfg) if (loss_creator and
+                                     not isinstance(loss_creator, type)) \
+            else (loss_creator() if isinstance(loss_creator, type) else None)
+
+        param_loader = None
+        if _is_torch_module(model):
+            module, param_loader = build_flax_from_torch(model)
+            jax_loss = convert_torch_loss(loss) if loss is not None else None
+            tx = None
+            if optimizer_creator is not None:
+                torch_opt = optimizer_creator(model, cfg)
+                tx = convert_torch_optimizer(torch_opt, model)
+            est = PyTorchTPUEstimator(module, loss=jax_loss,
+                                      optimizer=tx or "adam", metrics=metrics,
+                                      model_dir=model_dir, config=cfg)
+            est._param_loader = param_loader
+        else:
+            tx = None
+            if optimizer_creator is not None:
+                maybe = optimizer_creator(model, cfg)
+                tx = convert_torch_optimizer(maybe) or maybe
+            est = PyTorchTPUEstimator(model, loss=loss, optimizer=tx or "adam",
+                                      metrics=metrics, model_dir=model_dir,
+                                      config=cfg)
+        est.training_operator_cls = training_operator_cls
+        return est
+
+    latest_checkpoint = staticmethod(
+        lambda model_dir: __import__(
+            "analytics_zoo_tpu.orca.learn.estimator", fromlist=["Estimator"]
+        ).Estimator.latest_checkpoint(model_dir))
+
+
+class PyTorchTPUEstimator(TPUEstimator):
+    """TPUEstimator + torch-flavored conveniences (data loaders, imported
+    weights)."""
+
+    _param_loader = None
+    training_operator_cls = None
+
+    def fit(self, data, epochs=1, batch_size=32, **kwargs):
+        data = _maybe_from_dataloader(data, self.config, batch_size)
+        first_build = self.engine.params is None
+        if first_build and (self._param_loader is not None or
+                            self.training_operator_cls is not None):
+            it_kwargs = {k: kwargs[k] for k in ("feature_cols", "label_cols")
+                         if k in kwargs}
+            from .. import utils as learn_utils
+            it = learn_utils.data_to_iterator(
+                data, batch_size, self.ctx.mesh, config=self.config,
+                **it_kwargs)
+            sample = next(it.epoch(shuffle=False))
+            self.engine.build(tuple(np.asarray(a) for a in sample.x))
+            if self._param_loader is not None:
+                self._load_torch_weights()
+        if self.training_operator_cls is not None:
+            return self._fit_with_operator(data, epochs, batch_size, **kwargs)
+        return super().fit(data, epochs=epochs, batch_size=batch_size,
+                           **kwargs)
+
+    def _fit_with_operator(self, data, epochs, batch_size,
+                           feature_cols=None, label_cols=None, **_):
+        from .. import utils as learn_utils
+        op = self.training_operator_cls(self.config, self.engine,
+                                        world_rank=self.ctx.process_id)
+        it = learn_utils.data_to_iterator(
+            data, batch_size, self.ctx.mesh, feature_cols, label_cols,
+            shuffle=True, config=self.config)
+        stats = []
+        for ep in range(epochs):
+            s = op.train_epoch(it.epoch(), {"epoch_idx": ep})
+            s["epoch"] = ep + 1
+            stats.append(s)
+        self._operator = op
+        return stats
+
+    def evaluate(self, data, batch_size=32, **kwargs):
+        data = _maybe_from_dataloader(data, self.config, batch_size)
+        if self.engine.params is None and self._param_loader is not None:
+            from .. import utils as learn_utils
+            it = learn_utils.data_to_iterator(data, batch_size, self.ctx.mesh,
+                                              config=self.config)
+            sample = next(it.epoch(shuffle=False))
+            self.engine.build(tuple(np.asarray(a) for a in sample.x))
+            self._load_torch_weights()
+        return super().evaluate(data, batch_size=batch_size, **kwargs)
+
+    def predict(self, data, batch_size=32, **kwargs):
+        data = _maybe_from_dataloader(data, self.config, batch_size)
+        if self.engine.params is None and self._param_loader is not None:
+            from .. import utils as learn_utils
+            shards = learn_utils.xshards_from_arrays(data)
+            merged = learn_utils.concat_shards(shards)
+            self.engine.build(tuple(np.asarray(a[:1]) for a in merged["x"]))
+            self._load_torch_weights()
+        return super().predict(data, batch_size=batch_size, **kwargs)
+
+    def _load_torch_weights(self):
+        import jax
+        variables = {"params": jax.device_get(self.engine.params),
+                     **jax.device_get(self.engine.extra_vars)}
+        loaded = self._param_loader(variables)
+        state = self.engine.get_state()
+        state["params"] = loaded["params"]
+        state["extra_vars"] = {k: v for k, v in loaded.items()
+                               if k != "params"}
+        self.engine.set_state(state)
+
+
+def _maybe_from_dataloader(data, config, batch_size):
+    """Accept a torch DataLoader / Dataset (or a creator returning one) and
+    materialize to arrays — the reference wraps loaders with
+    DistributedSampler (torch_runner.py:222-249); on TPU the iterator's
+    output is just host data for the infeed."""
+    try:
+        import torch.utils.data as tud
+    except ImportError:
+        return data
+    produced = data
+    if callable(data) and not isinstance(data, (list, tuple, dict)):
+        try:
+            produced = data(config or {}, batch_size)
+        except TypeError:
+            return data
+        if not isinstance(produced, (tud.DataLoader, tud.Dataset)):
+            return data  # ordinary data_creator; handled downstream
+    if isinstance(produced, tud.Dataset) and not isinstance(
+            produced, tud.IterableDataset):
+        produced = tud.DataLoader(produced, batch_size=len(produced))
+    if isinstance(produced, tud.DataLoader):
+        xs, ys = [], []
+        for batch in produced:
+            if isinstance(batch, (list, tuple)) and len(batch) == 2:
+                x, y = batch
+                xs.append(np.asarray(x))
+                ys.append(np.asarray(y))
+            else:
+                xs.append(np.asarray(batch))
+        x = np.concatenate(xs)
+        if ys:
+            return {"x": x, "y": np.concatenate(ys)}
+        return {"x": x}
+    return data

