@@ -1,0 +1,3 @@
+from .inference_model import InferenceModel
+
+__all__ = ["InferenceModel"]

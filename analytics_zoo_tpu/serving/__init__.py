@@ -1,0 +1,6 @@
+from .client import InputQueue, OutputQueue
+from .engine import ClusterServing, Timer
+from .queue_api import FileBroker, InMemoryBroker, make_broker
+
+__all__ = ["InputQueue", "OutputQueue", "ClusterServing", "Timer",
+           "InMemoryBroker", "FileBroker", "make_broker"]

@@ -1,0 +1,72 @@
+"""Serving client — InputQueue / OutputQueue, same surface as the reference
+(pyzoo/zoo/serving/client.py:82 InputQueue.enqueue/predict, :234
+OutputQueue.dequeue/query), but speaking to a Broker (memory:// or file://)
+instead of Redis."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .codecs import decode_payload, encode_payload
+from .queue_api import Broker, make_broker
+
+
+class API:
+    def __init__(self, queue: str = "memory://serving_stream",
+                 host: Optional[str] = None, port: Optional[str] = None,
+                 name: str = "serving_stream"):
+        # host/port accepted for source compatibility with the Redis client
+        self.name = name
+        self.broker: Broker = make_broker(queue) if isinstance(queue, str) \
+            else queue
+
+
+class InputQueue(API):
+    def enqueue(self, uri: str, **data) -> str:
+        """enqueue(uri, t=ndarray) or multiple named tensors
+        (reference: client.py:144-233)."""
+        if not data:
+            raise ValueError("provide at least one named tensor, e.g. "
+                             "input_api.enqueue('my-id', t=arr)")
+        if len(data) == 1:
+            payload = encode_payload(np.asarray(next(iter(data.values()))),
+                                     meta={"uri": uri})
+        else:
+            payload = encode_payload({k: np.asarray(v)
+                                      for k, v in data.items()},
+                                     meta={"uri": uri})
+        self.broker.enqueue(uri, payload)
+        return uri
+
+    def predict(self, request_data, timeout_s: float = 30.0):
+        """Synchronous single prediction (reference: client.py:105-143)."""
+        uri = uuid.uuid4().hex
+        self.broker.enqueue(uri, encode_payload(np.asarray(request_data),
+                                                meta={"uri": uri}))
+        raw = self.broker.get_result(uri, timeout_s)
+        if raw is None:
+            raise TimeoutError(f"no result for {uri} within {timeout_s}s")
+        data, meta = decode_payload(raw)
+        if meta.get("error"):
+            raise RuntimeError(f"serving error: {meta['error']}")
+        return data
+
+
+class OutputQueue(API):
+    def query(self, uri: str, timeout_s: float = 10.0):
+        """(reference: client.py:238-252)"""
+        raw = self.broker.get_result(uri, timeout_s)
+        if raw is None:
+            return "{}"
+        data, _ = decode_payload(raw)
+        return data
+
+    def dequeue(self, uris, timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Fetch many results (reference: client.py:253-265)."""
+        out = {}
+        for uri in uris:
+            out[uri] = self.query(uri, timeout_s)
+        return out

@@ -1,0 +1,58 @@
+"""Wire codecs for serving payloads — ndarray <-> base64(arrow), matching the
+reference client's encoding (pyzoo/zoo/serving/client.py:267-282 b64 + arrow
+streaming format; JVM twin serving/arrow/ArrowSerializer.scala:170)."""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def encode_ndarray(arr: np.ndarray) -> str:
+    import pyarrow as pa
+    arr = np.ascontiguousarray(arr)
+    tensor = pa.Tensor.from_numpy(arr)
+    sink = pa.BufferOutputStream()
+    pa.ipc.write_tensor(tensor, sink)
+    return base64.b64encode(sink.getvalue().to_pybytes()).decode("ascii")
+
+
+def decode_ndarray(s: str) -> np.ndarray:
+    import pyarrow as pa
+    buf = base64.b64decode(s)
+    tensor = pa.ipc.read_tensor(pa.BufferReader(buf))
+    return tensor.to_numpy()
+
+
+def encode_payload(data: Any, meta: Dict | None = None) -> bytes:
+    """data: ndarray | list/tuple of ndarray | dict[str, ndarray]."""
+    if isinstance(data, np.ndarray):
+        body = {"kind": "tensor", "data": encode_ndarray(data)}
+    elif isinstance(data, (list, tuple)):
+        body = {"kind": "tensors",
+                "data": [encode_ndarray(np.asarray(a)) for a in data]}
+    elif isinstance(data, dict):
+        body = {"kind": "named",
+                "data": {k: encode_ndarray(np.asarray(v))
+                         for k, v in data.items()}}
+    else:
+        raise ValueError(f"cannot encode {type(data)}")
+    if meta:
+        body["meta"] = meta
+    return json.dumps(body).encode("utf-8")
+
+
+def decode_payload(raw: bytes) -> Tuple[Any, Dict]:
+    body = json.loads(raw.decode("utf-8"))
+    kind = body["kind"]
+    if kind == "tensor":
+        data = decode_ndarray(body["data"])
+    elif kind == "tensors":
+        data = [decode_ndarray(s) for s in body["data"]]
+    else:
+        data = {k: decode_ndarray(v) for k, v in body["data"].items()}
+    return data, body.get("meta", {})

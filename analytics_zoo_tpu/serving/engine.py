@@ -1,0 +1,136 @@
+"""Cluster Serving engine — queue -> dynamic batcher -> TPU inference -> results.
+
+The reference pipeline (SURVEY.md §3.5) is Redis stream -> Flink
+FlinkRedisSource (xreadGroup, engine/FlinkRedisSource.scala:78-104) ->
+FlinkInference -> ClusterServingInference batching
+(engine/ClusterServingInference.scala:36-152) -> InferenceModel.doPredict ->
+FlinkRedisSink. The TPU-native pipeline drops Flink entirely: a worker thread
+claims up to ``batch_size`` requests (waiting at most ``batch_timeout_ms`` —
+dynamic batching), stacks them, runs the shape-bucketed compiled executable,
+and writes per-request results back. Per-stage latency is tracked like the
+reference's Timer (serving/engine/Timer.scala:102).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..pipeline.inference.inference_model import InferenceModel
+from .codecs import decode_payload, encode_payload
+from .queue_api import Broker, make_broker
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class Timer:
+    """(reference: serving/engine/Timer.scala) — n-record latency stats."""
+
+    def __init__(self):
+        self.stats: Dict[str, List[float]] = defaultdict(list)
+
+    def time(self, name: str):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *a):
+                timer.stats[name].append(time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, vals in self.stats.items():
+            arr = np.asarray(vals)
+            out[name] = {"count": len(arr), "mean_ms": float(arr.mean() * 1e3),
+                         "p99_ms": float(np.percentile(arr, 99) * 1e3)}
+        return out
+
+
+class ClusterServing:
+    """(reference entry: serving/ClusterServing.scala:69; config via
+    utils/ClusterServingHelper.scala)"""
+
+    def __init__(self, model: InferenceModel,
+                 queue: str = "memory://serving_stream",
+                 batch_size: int = 32, batch_timeout_ms: float = 5.0,
+                 model_parallelism: int = 1):
+        self.model = model
+        self.broker: Broker = make_broker(queue) if isinstance(queue, str) \
+            else queue
+        self.batch_size = batch_size
+        self.batch_timeout = batch_timeout_ms / 1e3
+        # modelParallelism in the reference = number of model copies
+        # (ClusterServing.scala:60); XLA executables are reentrant so this is
+        # the number of batcher threads.
+        self.num_workers = model_parallelism
+        self.timer = Timer()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.records_out = 0
+
+    # --- worker loop --------------------------------------------------------
+    def _worker(self):
+        while not self._stop.is_set():
+            with self.timer.time("claim"):
+                batch = self.broker.claim_batch(self.batch_size,
+                                                self.batch_timeout)
+            if not batch:
+                continue
+            try:
+                self._process(batch)
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                logger.exception("serving batch failed: %s", e)
+                for item_id, _ in batch:
+                    self.broker.put_result(item_id, encode_payload(
+                        np.zeros(0), meta={"error": str(e)}))
+
+    def _process(self, batch):
+        with self.timer.time("decode"):
+            decoded = [decode_payload(p) for _, p in batch]
+            arrays = [d for d, _ in decoded]
+        with self.timer.time("batch"):
+            first = arrays[0]
+            if isinstance(first, list):
+                stacked = [np.stack([a[i] for a in arrays])
+                           for i in range(len(first))]
+            else:
+                stacked = np.stack(arrays)
+        with self.timer.time("inference"):
+            preds = self.model.predict(stacked)
+        with self.timer.time("encode"):
+            multi = isinstance(preds, (list, tuple))
+            for i, (item_id, _) in enumerate(batch):
+                if multi:
+                    out = [np.asarray(p[i]) for p in preds]
+                else:
+                    out = np.asarray(preds[i])
+                self.broker.put_result(item_id, encode_payload(out))
+        self.records_out += len(batch)
+
+    # --- lifecycle ----------------------------------------------------------
+    def start(self):
+        for i in range(self.num_workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"serving-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def metrics(self) -> Dict:
+        """(reference observability: Flink numRecordsOutPerSecond +
+        Timer stats)"""
+        return {"records_out": self.records_out, "stages": self.timer.summary()}

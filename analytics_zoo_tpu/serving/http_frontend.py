@@ -1,0 +1,75 @@
+"""HTTP frontend for Cluster Serving — aiohttp app mirroring the reference's
+akka-http FrontEndApp (zoo/.../serving/http/FrontEndApp.scala:41: GET /,
+PUT /predict with JSON instances; domain schema http/domains.scala).
+
+POST/PUT /predict body: {"instances": [{"t": [[...]]}, ...]} — each instance's
+tensors are enqueued onto the serving broker; the handler awaits results and
+returns {"predictions": [...]}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from .codecs import decode_payload, encode_payload
+from .queue_api import Broker, make_broker
+
+
+def create_app(queue="memory://serving_stream", timeout_s: float = 30.0):
+    from aiohttp import web
+
+    broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
+
+    async def index(request):
+        return web.Response(text="welcome to analytics zoo tpu serving "
+                                 "frontend")
+
+    async def predict(request):
+        body = await request.json()
+        instances = body.get("instances")
+        if not isinstance(instances, list):
+            return web.json_response({"error": "missing 'instances' list"},
+                                     status=400)
+        loop = asyncio.get_running_loop()
+        uris = []
+        for inst in instances:
+            uri = uuid.uuid4().hex
+            if isinstance(inst, dict):
+                named = {k: np.asarray(v, dtype=np.float32)
+                         for k, v in inst.items()}
+                data = next(iter(named.values())) if len(named) == 1 else named
+            else:
+                data = np.asarray(inst, dtype=np.float32)
+            broker.enqueue(uri, encode_payload(data, meta={"uri": uri}))
+            uris.append(uri)
+
+        def fetch(uri):
+            raw = broker.get_result(uri, timeout_s)
+            if raw is None:
+                return None
+            arr, meta = decode_payload(raw)
+            if meta.get("error"):
+                return {"error": meta["error"]}
+            if isinstance(arr, (list, tuple)):
+                return [a.tolist() for a in arr]
+            return arr.tolist()
+
+        results = await asyncio.gather(
+            *[loop.run_in_executor(None, fetch, u) for u in uris])
+        return web.json_response({"predictions": results})
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_post("/predict", predict)
+    app.router.add_put("/predict", predict)
+    return app
+
+
+def run_frontend(queue="memory://serving_stream", host: str = "0.0.0.0",
+                 port: int = 10020):
+    from aiohttp import web
+    web.run_app(create_app(queue), host=host, port=port)

@@ -1,0 +1,166 @@
+"""Serving queue backends.
+
+The reference's transport is Redis streams + consumer groups
+(FlinkRedisSource.scala:78-104 xreadGroup; results via pipelined HSET,
+FlinkRedisSink.scala:29). This module provides the same contract —
+append-only input stream with group consumption + keyed result store — with
+two TPU-host-friendly backends:
+
+* InMemoryBroker  — intra-process (tests, embedded serving)
+* FileBroker      — spool-directory stream + result files; works across
+  processes on one host or over a shared filesystem, no external service
+
+A Redis backend can slot in later behind the same three methods
+(enqueue/claim_batch/put_result) when deployments have Redis available.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Broker:
+    def enqueue(self, item_id: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def claim_batch(self, max_items: int, timeout_s: float
+                    ) -> List[Tuple[str, bytes]]:
+        """Blocking claim of up to max_items; returns [] on timeout."""
+        raise NotImplementedError
+
+    def put_result(self, item_id: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def get_result(self, item_id: str, timeout_s: float = 10.0
+                   ) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def pending(self) -> int:
+        raise NotImplementedError
+
+
+class InMemoryBroker(Broker):
+    _instances: Dict[str, "InMemoryBroker"] = {}
+
+    @classmethod
+    def get(cls, name: str = "serving_stream") -> "InMemoryBroker":
+        if name not in cls._instances:
+            cls._instances[name] = cls()
+        return cls._instances[name]
+
+    def __init__(self):
+        self._q: List[Tuple[str, bytes]] = []
+        self._results: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def enqueue(self, item_id, payload):
+        with self._cv:
+            self._q.append((item_id, payload))
+            self._cv.notify_all()
+
+    def claim_batch(self, max_items, timeout_s):
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while not self._q:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return []
+                self._cv.wait(remaining)
+            batch = self._q[:max_items]
+            del self._q[:len(batch)]
+            return batch
+
+    def put_result(self, item_id, payload):
+        with self._cv:
+            self._results[item_id] = payload
+            self._cv.notify_all()
+
+    def get_result(self, item_id, timeout_s=10.0):
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while item_id not in self._results:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._results.pop(item_id)
+
+    def pending(self):
+        with self._cv:
+            return len(self._q)
+
+
+class FileBroker(Broker):
+    """Spool-dir stream: input items are files under in/, claimed atomically
+    by rename into claimed/, results under out/<id>."""
+
+    def __init__(self, root: str):
+        self.root = root
+        for sub in ("in", "claimed", "out"):
+            os.makedirs(os.path.join(root, sub), exist_ok=True)
+
+    def enqueue(self, item_id, payload):
+        tmp = os.path.join(self.root, "in", f".tmp-{uuid.uuid4().hex}")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(
+            self.root, "in", f"{time.time_ns()}-{item_id}"))
+
+    def claim_batch(self, max_items, timeout_s):
+        deadline = time.time() + timeout_s
+        while True:
+            names = sorted(n for n in os.listdir(
+                os.path.join(self.root, "in")) if not n.startswith("."))
+            batch = []
+            for n in names[:max_items]:
+                src = os.path.join(self.root, "in", n)
+                dst = os.path.join(self.root, "claimed", n)
+                try:
+                    os.replace(src, dst)  # atomic claim
+                except OSError:
+                    continue  # another worker won
+                with open(dst, "rb") as f:
+                    payload = f.read()
+                os.unlink(dst)
+                item_id = n.split("-", 1)[1]
+                batch.append((item_id, payload))
+            if batch or time.time() >= deadline:
+                return batch
+            time.sleep(0.005)
+
+    def put_result(self, item_id, payload):
+        tmp = os.path.join(self.root, "out", f".tmp-{uuid.uuid4().hex}")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(self.root, "out", item_id))
+
+    def get_result(self, item_id, timeout_s=10.0):
+        path = os.path.join(self.root, "out", item_id)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    data = f.read()
+                os.unlink(path)
+                return data
+            time.sleep(0.005)
+        return None
+
+    def pending(self):
+        return len([n for n in os.listdir(os.path.join(self.root, "in"))
+                    if not n.startswith(".")])
+
+
+def make_broker(spec: str = "memory://serving_stream") -> Broker:
+    if spec.startswith("memory://"):
+        return InMemoryBroker.get(spec[len("memory://"):] or "serving_stream")
+    if spec.startswith("file://"):
+        return FileBroker(spec[len("file://"):])
+    raise ValueError(f"unknown broker spec {spec} (memory:// or file://)")

@@ -1,0 +1,115 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, FileBroker,
+                                       InMemoryBroker, InputQueue, OutputQueue)
+from analytics_zoo_tpu.serving.codecs import (decode_ndarray, decode_payload,
+                                              encode_ndarray, encode_payload)
+
+
+def _simple_model():
+    import flax.linen as nn
+    import jax
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.float32))
+    return InferenceModel().load_jax(module, variables)
+
+
+def test_codec_roundtrip():
+    arr = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+    assert np.array_equal(decode_ndarray(encode_ndarray(arr)), arr)
+    payload = encode_payload({"a": arr, "b": arr * 2}, meta={"uri": "x"})
+    data, meta = decode_payload(payload)
+    assert meta["uri"] == "x"
+    np.testing.assert_array_equal(data["b"], arr * 2)
+
+
+def test_inference_model_bucketing(orca_context):
+    model = _simple_model()
+    out = model.predict(np.random.rand(5, 4).astype(np.float32))
+    assert out.shape == (5, 3)
+    out2 = model.predict(np.random.rand(7, 4).astype(np.float32))
+    assert out2.shape == (7, 3)
+    # 5 and 7 share the size-8 bucket -> one compiled executable
+    assert len(model._cache) == 1
+
+
+def test_inference_model_save_load(orca_context, tmp_path):
+    import flax.linen as nn
+    import jax
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4), np.float32))
+    model = InferenceModel().load_jax(module, variables)
+    x = np.random.rand(4, 4).astype(np.float32)
+    expected = model.predict(x)
+
+    path = str(tmp_path / "model.pkl")
+    model.save(module, path)
+    loaded = InferenceModel().load(path)
+    np.testing.assert_allclose(loaded.predict(x), expected, rtol=1e-5)
+
+
+def test_cluster_serving_end_to_end(orca_context):
+    model = _simple_model()
+    broker = InMemoryBroker()
+    serving = ClusterServing(model, queue=broker, batch_size=8,
+                             batch_timeout_ms=10).start()
+    try:
+        in_q = InputQueue(queue=broker)
+        out_q = OutputQueue(queue=broker)
+        x = np.random.rand(4).astype(np.float32)
+        result = in_q.predict(x, timeout_s=10)
+        assert np.asarray(result).shape == (3,)
+
+        uris = [in_q.enqueue(f"req-{i}", t=np.random.rand(4).astype(np.float32))
+                for i in range(10)]
+        results = out_q.dequeue(uris, timeout_s=10)
+        assert len(results) == 10
+        assert all(np.asarray(v).shape == (3,) for v in results.values())
+        m = serving.metrics()
+        assert m["records_out"] >= 11
+        assert "inference" in m["stages"]
+    finally:
+        serving.stop()
+
+
+def test_file_broker_roundtrip(tmp_path):
+    broker = FileBroker(str(tmp_path / "spool"))
+    broker.enqueue("a", b"payload-a")
+    broker.enqueue("b", b"payload-b")
+    assert broker.pending() == 2
+    batch = broker.claim_batch(10, timeout_s=1)
+    assert sorted(i for i, _ in batch) == ["a", "b"]
+    broker.put_result("a", b"result-a")
+    assert broker.get_result("a", timeout_s=1) == b"result-a"
+    assert broker.get_result("zzz", timeout_s=0.05) is None
+
+
+def test_serving_keras_savedmodel(orca_context, tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    im = InferenceModel().load_tf(path)
+    x = np.random.rand(3, 4).astype(np.float32)
+    out = im.predict(x)
+    np.testing.assert_allclose(out, model(x).numpy(), rtol=1e-4, atol=1e-5)
