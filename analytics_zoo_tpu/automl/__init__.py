@@ -1,0 +1,7 @@
+from . import hp
+from .auto_estimator import AutoEstimator
+from .model_builder import ModelBuilder
+from .search.search_engine import SearchEngine, TPUSearchEngine, Trial
+
+__all__ = ["hp", "AutoEstimator", "ModelBuilder", "SearchEngine",
+           "TPUSearchEngine", "Trial"]

@@ -1,0 +1,120 @@
+"""AutoEstimator — HPO front door (reference: pyzoo/zoo/orca/automl/
+auto_estimator.py:20-140: from_torch/from_keras + fit(data, search_space,
+n_sampling, epochs, metric) + get_best_model)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .model_builder import ModelBuilder
+from .search.search_engine import TPUSearchEngine
+
+
+class AutoEstimator:
+    def __init__(self, model_builder: ModelBuilder, logs_dir: str = "/tmp/auto",
+                 resources_per_trial=None, name: str = "auto_estimator"):
+        self.model_builder = model_builder
+        self.searcher = TPUSearchEngine(name=name, logs_dir=logs_dir)
+        self._fitted = False
+
+    @staticmethod
+    def from_torch(*, model_creator: Callable,
+                   optimizer: Optional[Callable] = None,
+                   loss: Optional[Callable] = None,
+                   logs_dir: str = "/tmp/auto_estimator_logs",
+                   resources_per_trial=None,
+                   name: str = "auto_torch") -> "AutoEstimator":
+        """(reference: auto_estimator.py:34)"""
+        builder = ModelBuilder(model_creator,
+                               optimizer_creator=_wrap_opt(optimizer),
+                               loss_creator=_wrap_loss(loss))
+        return AutoEstimator(builder, logs_dir, resources_per_trial, name)
+
+    @staticmethod
+    def from_keras(*, model_creator: Callable,
+                   logs_dir: str = "/tmp/auto_estimator_logs",
+                   resources_per_trial=None, loss=None, optimizer=None,
+                   name: str = "auto_keras") -> "AutoEstimator":
+        """(reference: auto_estimator.py:75; loss/optimizer extras cover flax
+        creators, which have no keras compile() to carry them)"""
+        builder = ModelBuilder(model_creator,
+                               optimizer_creator=_wrap_opt(optimizer),
+                               loss_creator=_wrap_loss(loss))
+        return AutoEstimator(builder, logs_dir, resources_per_trial, name)
+
+    def fit(self, data, epochs: int = 1, validation_data=None,
+            metric: Optional[str] = None, metric_mode: Optional[str] = None,
+            metric_threshold=None, n_sampling: int = 1,
+            search_space: Optional[Dict] = None, search_alg=None,
+            scheduler=None, **_) -> "AutoEstimator":
+        """(reference: auto_estimator.py:99)"""
+        if self._fitted:
+            raise RuntimeError(
+                "This AutoEstimator has already been fitted and cannot fit "
+                "again.")  # same guard as the reference
+        metric = metric or "loss"
+        if metric_mode is None:
+            metric_mode = "max" if any(
+                s in metric for s in ("acc", "auc", "top", "r2")) else "min"
+        self.searcher.compile(data, self.model_builder, search_space or {},
+                              n_sampling=n_sampling, epochs=epochs,
+                              validation_data=validation_data, metric=metric,
+                              metric_mode=metric_mode)
+        self.searcher.run()
+        self._fitted = True
+        return self
+
+    def get_best_model(self):
+        """Rebuild the winning trial's estimator with its trained weights
+        (reference: auto_estimator.py:121)."""
+        best = self.searcher.get_best_trial()
+        model = self.model_builder(best.config, _default_mesh())
+        est = model._build_estimator(self.searcher.metric)
+        if best.model_state is not None:
+            # adopt the trained params without re-fitting
+            est.engine.params = best.model_state["params"]
+            est.engine.extra_vars = best.model_state.get("extra_vars", {})
+            est.engine.set_state(best.model_state)
+        return est
+
+    def get_best_config(self) -> Dict:
+        return dict(self.searcher.get_best_trial().config)
+
+    @property
+    def best_trial(self):
+        return self.searcher.get_best_trial()
+
+    def get_trials(self):
+        return self.searcher._trials
+
+
+def _wrap_opt(optimizer):
+    if optimizer is None:
+        return None
+    if isinstance(optimizer, str):
+        def creator(model, config):
+            import optax
+            lr = config.get("lr", 1e-3)
+            return {"sgd": optax.sgd, "adam": optax.adam,
+                    "rmsprop": optax.rmsprop,
+                    "adagrad": optax.adagrad}[optimizer.lower()](lr)
+        return creator
+    return optimizer
+
+
+def _wrap_loss(loss):
+    if loss is None:
+        return None
+    if isinstance(loss, str):
+        from ..orca.learn.losses import convert_loss
+        fn = convert_loss(loss)
+        return lambda config: fn
+    if callable(loss) and not isinstance(loss, type):
+        produced_takes_config = False
+        return lambda config: loss
+    return loss
+
+
+def _default_mesh():
+    from ..common.context import get_context
+    return get_context().mesh

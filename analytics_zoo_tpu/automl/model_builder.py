@@ -1,0 +1,97 @@
+"""Model builders bridging creator functions to the search engine.
+
+Reference: pyzoo/zoo/automl/model/model_builder.py + base_pytorch_model.py:320
+/ base_keras_model.py:169 (build(config) -> model with fit_eval). Here one
+builder covers every framework because the engine is framework-neutral: the
+creator returns a flax module (or torch/keras convertible via the bridges) and
+fit_eval trains on a trial-private single-chip mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ModelBuilder:
+    def __init__(self, model_creator: Callable,
+                 optimizer_creator: Optional[Callable] = None,
+                 loss_creator: Optional[Callable] = None,
+                 metric_extra: Optional[list] = None):
+        self.model_creator = model_creator
+        self.optimizer_creator = optimizer_creator
+        self.loss_creator = loss_creator
+        self.metric_extra = metric_extra or []
+
+    def __call__(self, config: Dict, mesh) -> "TrialModel":
+        return TrialModel(self, config, mesh)
+
+
+class TrialModel:
+    def __init__(self, builder: ModelBuilder, config: Dict, mesh):
+        self.builder = builder
+        self.config = dict(config)
+        self.mesh = mesh
+        self.estimator = None
+
+    def _build_estimator(self, metric: str):
+        from ..orca.learn.estimator import TPUEstimator
+        from ..orca.learn.pytorch.estimator import (_is_torch_module)
+        from ..orca.learn.pytorch.torch_bridge import (
+            convert_torch_loss, convert_torch_optimizer)
+
+        model = self.builder.model_creator(self.config)
+        loss = None
+        if self.builder.loss_creator is not None:
+            loss = self.builder.loss_creator(self.config) if not isinstance(
+                self.builder.loss_creator, type) else self.builder.loss_creator()
+        optimizer: Any = "adam"
+        param_loader = None
+        if _is_torch_module(model):
+            from ..orca.learn.pytorch.torch_bridge import build_flax_from_torch
+            model, param_loader = build_flax_from_torch(model)
+            loss = convert_torch_loss(loss) if loss is not None else None
+        else:
+            try:
+                import tensorflow as tf
+                if isinstance(model, tf.keras.Model):
+                    from ..orca.learn.tf2.keras_bridge import (
+                        build_flax_from_keras, extract_compile_args)
+                    k_model = model
+                    model, param_loader = build_flax_from_keras(k_model)
+                    k_loss, k_opt, _ = extract_compile_args(k_model)
+                    loss = loss or k_loss
+                    optimizer = k_opt
+            except ImportError:
+                pass
+        if loss is None and self.config.get("loss"):
+            from ..orca.learn.losses import convert_loss
+            loss = convert_loss(self.config["loss"])
+        if self.builder.optimizer_creator is not None:
+            maybe = self.builder.optimizer_creator(model, self.config)
+            optimizer = convert_torch_optimizer(maybe) or maybe
+        elif "lr" in self.config:
+            import optax
+            optimizer = optax.adam(self.config["lr"])
+        metrics = [metric] if metric not in ("loss",) else None
+        est = TPUEstimator(model, loss=loss, optimizer=optimizer,
+                           metrics=metrics, config=self.config,
+                           mesh=self.mesh)
+        self._param_loader = param_loader
+        return est
+
+    def fit_eval(self, data, validation_data=None, epochs: int = 1,
+                 metric: str = "mse") -> Tuple[float, Dict, Any]:
+        est = self.estimator = self._build_estimator(metric)
+        batch_size = int(self.config.get("batch_size", 32))
+        data = data(self.config, batch_size) if callable(data) else data
+        if validation_data is None:
+            validation_data = data
+        elif callable(validation_data):
+            validation_data = validation_data(self.config, batch_size)
+        est.fit(data, epochs=epochs, batch_size=batch_size, verbose=False)
+        result = est.evaluate(validation_data, batch_size=batch_size,
+                              verbose=False)
+        score = result.get(metric, result.get("loss"))
+        return float(score), result, est.engine.get_state()

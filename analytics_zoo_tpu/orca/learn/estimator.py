@@ -70,8 +70,9 @@ class TPUEstimator:
 
     def __init__(self, module, loss=None, optimizer="adam", metrics=None,
                  model_dir: Optional[str] = None,
-                 config: Optional[dict] = None, seed: int = 0):
+                 config: Optional[dict] = None, seed: int = 0, mesh=None):
         self.ctx = get_context()
+        self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.module = module
         self.config = config or {}
         self.model_dir = model_dir
@@ -79,7 +80,7 @@ class TPUEstimator:
         self.metrics = convert_metrics_list(metrics)
         tx = convert_optimizer(optimizer)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
-                                  self.ctx.mesh, seed=seed)
+                                  self.mesh, seed=seed)
         self._trainer_state = TrainerState()
         self.train_stats: List[Dict[str, float]] = []
 
@@ -96,7 +97,7 @@ class TPUEstimator:
         callable — same surface as the reference estimators' fit
         (orca/learn/tf2/estimator.py:166-263)."""
         it = learn_utils.data_to_iterator(
-            data, batch_size, self.ctx.mesh, feature_cols, label_cols,
+            data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=shuffle, config=self.config)
         sample = next(it.epoch(shuffle=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
@@ -148,7 +149,7 @@ class TPUEstimator:
                  verbose: bool = True) -> Dict[str, float]:
         """(reference surface: orca/learn/tf2/estimator.py:264-347)"""
         it = learn_utils.data_to_iterator(
-            data, batch_size, self.ctx.mesh, feature_cols, label_cols,
+            data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=False, config=self.config)
         sample = next(it.epoch(shuffle=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
@@ -174,7 +175,7 @@ class TPUEstimator:
         is_shards = isinstance(data, HostXShards)
         shards = learn_utils.xshards_from_arrays(data, feature_cols, None)
         merged = learn_utils.concat_shards(shards)
-        it = learn_utils.BatchIterator(merged, batch_size, self.ctx.mesh,
+        it = learn_utils.BatchIterator(merged, batch_size, self.mesh,
                                        pad_tail=True)
         self.engine.build(tuple(np.asarray(a[:1]) for a in merged["x"]))
         outs = []

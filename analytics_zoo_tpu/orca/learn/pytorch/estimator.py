@@ -102,7 +102,7 @@ class PyTorchTPUEstimator(TPUEstimator):
                          if k in kwargs}
             from .. import utils as learn_utils
             it = learn_utils.data_to_iterator(
-                data, batch_size, self.ctx.mesh, config=self.config,
+                data, batch_size, self.mesh, config=self.config,
                 **it_kwargs)
             sample = next(it.epoch(shuffle=False))
             self.engine.build(tuple(np.asarray(a) for a in sample.x))
@@ -119,7 +119,7 @@ class PyTorchTPUEstimator(TPUEstimator):
         op = self.training_operator_cls(self.config, self.engine,
                                         world_rank=self.ctx.process_id)
         it = learn_utils.data_to_iterator(
-            data, batch_size, self.ctx.mesh, feature_cols, label_cols,
+            data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=True, config=self.config)
         stats = []
         for ep in range(epochs):
@@ -133,7 +133,7 @@ class PyTorchTPUEstimator(TPUEstimator):
         data = _maybe_from_dataloader(data, self.config, batch_size)
         if self.engine.params is None and self._param_loader is not None:
             from .. import utils as learn_utils
-            it = learn_utils.data_to_iterator(data, batch_size, self.ctx.mesh,
+            it = learn_utils.data_to_iterator(data, batch_size, self.mesh,
                                               config=self.config)
             sample = next(it.epoch(shuffle=False))
             self.engine.build(tuple(np.asarray(a) for a in sample.x))

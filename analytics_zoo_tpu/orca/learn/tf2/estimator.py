@@ -78,7 +78,7 @@ class TF2TPUEstimator(TPUEstimator):
                                                  label_cols) \
             if not callable(data) else None
         if shards is None:
-            it = learn_utils.data_to_iterator(data, batch_size, self.ctx.mesh,
+            it = learn_utils.data_to_iterator(data, batch_size, self.mesh,
                                               feature_cols, label_cols,
                                               config=self.config)
             sample = next(it.epoch(shuffle=False))

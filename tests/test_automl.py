@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.automl import AutoEstimator, hp
+
+
+def test_hp_sampling():
+    rng = np.random.RandomState(0)
+    space = {
+        "lr": hp.loguniform(1e-4, 1e-1),
+        "hidden": hp.choice([8, 16, 32]),
+        "units": hp.randint(1, 5),
+        "drop": hp.quniform(0.1, 0.5, 0.1),
+        "const": 7,
+    }
+    cfg = hp.sample_config(space, rng)
+    assert 1e-4 <= cfg["lr"] <= 1e-1
+    assert cfg["hidden"] in (8, 16, 32)
+    assert 1 <= cfg["units"] <= 5
+    assert abs(cfg["drop"] * 10 - round(cfg["drop"] * 10)) < 1e-9
+    assert cfg["const"] == 7
+
+
+def test_hp_grid_expansion():
+    space = {"a": hp.grid_search([1, 2, 3]), "b": hp.grid_search([10, 20]),
+             "c": hp.uniform(0, 1)}
+    grids = hp.grid_configs(space)
+    assert len(grids) == 6
+    assert {g["a"] for g in grids} == {1, 2, 3}
+
+
+def _make_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    w = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = (x @ w + 0.1).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def test_auto_estimator_search(orca_context):
+    import flax.linen as nn
+
+    def model_creator(config):
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.relu(nn.Dense(config.get("hidden", 8))(x))
+                return nn.Dense(1)(h)[:, 0]
+        return MLP()
+
+    auto = AutoEstimator.from_keras(model_creator=model_creator, loss="mse")
+    data = _make_data()
+    auto.fit(data, epochs=8, validation_data=_make_data(seed=1),
+             metric="mse", metric_mode="min", n_sampling=2,
+             search_space={"lr": hp.grid_search([0.1, 0.0001]),
+                           "hidden": hp.choice([8, 16]),
+                           "batch_size": 64})
+    trials = auto.get_trials()
+    assert len(trials) == 4  # 2 grid x 2 sampling
+    assert all(t.state == "done" for t in trials)
+    best_cfg = auto.get_best_config()
+    assert best_cfg["lr"] == 0.1  # big lr wins on this easy problem
+
+    best = auto.get_best_model()
+    res = best.evaluate(data, batch_size=64, verbose=False)
+    assert res["loss"] < 0.5
+
+
+def test_auto_estimator_refuses_double_fit(orca_context):
+    import flax.linen as nn
+
+    def mc(config):
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[:, 0]
+        return M()
+
+    auto = AutoEstimator.from_keras(model_creator=mc, loss="mse")
+    auto.fit(_make_data(64), epochs=1, metric="mse",
+             search_space={"lr": 0.01, "batch_size": 32})
+    with pytest.raises(RuntimeError):
+        auto.fit(_make_data(64), epochs=1, metric="mse",
+                 search_space={"lr": 0.01, "batch_size": 32})
