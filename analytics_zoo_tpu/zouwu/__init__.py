@@ -1,0 +1,5 @@
+from .model.forecast import (Forecaster, LSTMForecaster, MTNetForecaster,
+                             Seq2SeqForecaster, TCNForecaster)
+
+__all__ = ["Forecaster", "LSTMForecaster", "TCNForecaster",
+           "Seq2SeqForecaster", "MTNetForecaster"]

@@ -1,0 +1,191 @@
+"""AutoTS — automated time-series pipeline (reference:
+pyzoo/zoo/zouwu/autots/forecast.py:22 AutoTSTrainer.fit -> :94 TSPipeline;
+search path SURVEY.md §3.6). Trials run on the chip-pinned TPUSearchEngine
+instead of Ray Tune actors."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ...automl import hp
+from ...automl.search.search_engine import TPUSearchEngine
+from ..config.recipe import LSTMGridRandomRecipe, Recipe
+from ..feature.time_sequence import TimeSequenceFeatureTransformer
+from ..model.forecast import LSTMForecaster, Seq2SeqForecaster, TCNForecaster
+
+
+class AutoTSTrainer:
+    """(reference: zouwu/autots/forecast.py:22-93)"""
+
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 horizon: int = 1, extra_features_col: Optional[List] = None,
+                 search_alg=None, search_alg_params=None, scheduler=None,
+                 scheduler_params=None, name: str = "autots"):
+        self.dt_col = dt_col
+        self.target_col = target_col
+        self.horizon = horizon
+        self.extra_features_col = extra_features_col
+        self.name = name
+
+    def fit(self, train_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            metric: str = "mse", recipe: Optional[Recipe] = None,
+            mc: bool = False, resources_per_trial=None,
+            upload_dir=None) -> "TSPipeline":
+        recipe = recipe or LSTMGridRandomRecipe(num_rand_samples=1)
+        space = recipe.search_space([])
+        model_type = recipe.model_type()
+        trainer = self
+
+        class _TSTrialModel:
+            def __init__(self, config, mesh):
+                self.config = dict(config)
+                self.mesh = mesh
+
+            def fit_eval(self, data, validation_data, epochs, metric):
+                cfg = self.config
+                past = int(cfg.get("past_seq_len", 50))
+                tsft = TimeSequenceFeatureTransformer(
+                    horizon=trainer.horizon, dt_col=trainer.dt_col,
+                    target_col=trainer.target_col,
+                    extra_features_col=trainer.extra_features_col)
+                x, y = tsft.fit_transform(data, past_seq_len=past)
+                if validation_data is not None:
+                    vx, vy = tsft.transform(validation_data, is_train=True)
+                else:
+                    vx, vy = x, y
+                forecaster = trainer._build_forecaster(
+                    model_type, cfg, tsft.feature_num)
+                target_y = y if model_type == "LSTM" and trainer.horizon == 1 \
+                    else y[..., None]
+                vtarget = vy if model_type == "LSTM" and trainer.horizon == 1 \
+                    else vy[..., None]
+                if model_type == "LSTM" and trainer.horizon == 1:
+                    target_y, vtarget = y[:, 0:1], vy[:, 0:1]
+                forecaster.fit(x, target_y,
+                               epochs=int(getattr(recipe, "epochs", epochs)
+                                          or epochs),
+                               batch_size=int(cfg.get("batch_size", 32)))
+                pred = forecaster.predict(vx)
+                score = float(np.mean(
+                    (pred.reshape(vtarget.shape) - vtarget) ** 2))
+                state = {"forecaster": forecaster, "tsft": tsft}
+                return score, {metric: score}, state
+
+        engine = TPUSearchEngine(name=self.name)
+        engine.compile(train_df, lambda cfg, mesh: _TSTrialModel(cfg, mesh),
+                       space, n_sampling=recipe.num_samples,
+                       epochs=getattr(recipe, "training_iteration", 5),
+                       validation_data=validation_df, metric=metric,
+                       metric_mode="min")
+        engine.run()
+        best = engine.get_best_trial()
+        return TSPipeline(best.model_state["forecaster"],
+                          best.model_state["tsft"], best.config, self)
+
+    def _build_forecaster(self, model_type: str, cfg: Dict, feature_num: int):
+        if model_type == "TCN":
+            return TCNForecaster(
+                past_seq_len=int(cfg.get("past_seq_len", 50)),
+                future_seq_len=self.horizon,
+                input_feature_num=feature_num, output_feature_num=1,
+                num_channels=cfg.get("num_channels", (16,) * 3),
+                kernel_size=int(cfg.get("kernel_size", 3)),
+                dropout=float(cfg.get("dropout", 0.2)),
+                lr=float(cfg.get("lr", 1e-3)),
+                loss=cfg.get("loss", "mse"))
+        if model_type == "Seq2Seq":
+            return Seq2SeqForecaster(
+                past_seq_len=int(cfg.get("past_seq_len", 50)),
+                future_seq_len=self.horizon,
+                input_feature_num=feature_num, output_feature_num=1,
+                lstm_hidden_dim=int(cfg.get("latent_dim", 64)),
+                lr=float(cfg.get("lr", 1e-3)))
+        return LSTMForecaster(
+            target_dim=self.horizon, feature_dim=feature_num,
+            lstm_units=cfg.get("lstm_units", (16, 8)),
+            dropouts=cfg.get("dropouts", 0.2),
+            lr=float(cfg.get("lr", 1e-3)), loss=cfg.get("loss", "mse"))
+
+
+class TSPipeline:
+    """(reference: zouwu/autots/forecast.py:94-200: predict/evaluate/
+    save/load + incremental fit)"""
+
+    def __init__(self, forecaster, tsft: TimeSequenceFeatureTransformer,
+                 config: Dict, trainer: AutoTSTrainer):
+        self.forecaster = forecaster
+        self.tsft = tsft
+        self.config = config
+        self.trainer = trainer
+
+    def predict(self, input_df: pd.DataFrame) -> pd.DataFrame:
+        x, _ = self.tsft.transform(input_df, is_train=False)
+        pred = self.forecaster.predict(x)
+        pred = self.tsft.inverse_transform_y(
+            pred.reshape(pred.shape[0], -1))
+        dt = pd.to_datetime(input_df[self.trainer.dt_col])
+        freq = dt.diff().mode().iloc[0] if len(dt) > 1 else pd.Timedelta("1h")
+        rows = []
+        for i in range(pred.shape[0]):
+            base = dt.iloc[min(self.tsft.past_seq_len - 1 + i, len(dt) - 1)]
+            rows.append([base + freq] + list(pred[i]))
+        cols = [self.trainer.dt_col] + [
+            f"{self.trainer.target_col}_{j}" if pred.shape[1] > 1 else
+            self.trainer.target_col for j in range(pred.shape[1])]
+        return pd.DataFrame(rows, columns=cols)
+
+    def evaluate(self, input_df: pd.DataFrame,
+                 metrics: List[str] = ("mse",),
+                 multioutput: str = "uniform_average") -> Dict[str, float]:
+        from ..model.forecast import evaluate_metrics
+        x, y = self.tsft.transform(input_df, is_train=True)
+        pred = self.forecaster.predict(x)
+        y2 = y if pred.ndim == 2 and pred.shape == y.shape else \
+            y.reshape(pred.shape) if y.size == pred.size else y[:, :1]
+        return evaluate_metrics(y2, pred.reshape(y2.shape), metrics)
+
+    def fit(self, input_df, validation_df=None, mc=False, epochs: int = 1,
+            **_):
+        """Incremental fit on new data (reference: forecast.py:110)."""
+        x, y = self.tsft.transform(input_df, is_train=True)
+        target = y[:, 0:1] if getattr(self.forecaster.module, "target_dim",
+                                      None) == 1 else y[..., None]
+        if isinstance(self.forecaster, LSTMForecaster):
+            target = y[:, :self.forecaster.module.target_dim]
+        self.forecaster.fit(x, target, epochs=epochs,
+                            batch_size=int(self.config.get("batch_size", 32)))
+        return self
+
+    def save(self, pipeline_file: str):
+        import cloudpickle
+        state = {"config": self.config,
+                 "tsft": self.tsft,
+                 "engine_state": self.forecaster.estimator.engine.get_state(),
+                 "module": self.forecaster.module,
+                 "trainer": {"dt_col": self.trainer.dt_col,
+                             "target_col": self.trainer.target_col,
+                             "horizon": self.trainer.horizon,
+                             "extra": self.trainer.extra_features_col}}
+        with open(pipeline_file, "wb") as f:
+            cloudpickle.dump(state, f)
+        return pipeline_file
+
+    @staticmethod
+    def load(pipeline_file: str) -> "TSPipeline":
+        import cloudpickle
+        from ..model.forecast import Forecaster
+        with open(pipeline_file, "rb") as f:
+            state = cloudpickle.load(f)
+        t = state["trainer"]
+        trainer = AutoTSTrainer(dt_col=t["dt_col"], target_col=t["target_col"],
+                                horizon=t["horizon"],
+                                extra_features_col=t["extra"])
+        forecaster = Forecaster(state["module"])
+        forecaster.estimator.engine.set_state(state["engine_state"])
+        forecaster._fitted = True
+        return TSPipeline(forecaster, state["tsft"], state["config"], trainer)

@@ -1,0 +1,89 @@
+"""AutoTS recipes — search-space presets (reference:
+pyzoo/zoo/zouwu/config/recipe.py:714 LoC: SmokeRecipe, LSTMGridRandomRecipe,
+Seq2SeqRandomRecipe, MTNetGridRandomRecipe, TCNGridRandomRecipe, ...)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ...automl import hp
+
+
+class Recipe:
+    num_samples = 1
+    training_iteration = 10
+
+    def search_space(self, all_available_features: List[str]) -> Dict:
+        raise NotImplementedError
+
+    def model_type(self) -> str:
+        return "LSTM"
+
+
+class SmokeRecipe(Recipe):
+    """(reference: recipe.py SmokeRecipe — one tiny config for CI)"""
+    num_samples = 1
+    training_iteration = 1
+
+    def search_space(self, all_available_features):
+        return {"lstm_units": [8], "dropouts": 0.1, "lr": 0.01,
+                "batch_size": 32, "past_seq_len": 12, "loss": "mse"}
+
+
+class LSTMGridRandomRecipe(Recipe):
+    """(reference: recipe.py LSTMGridRandomRecipe)"""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 training_iteration: int = 10,
+                 lstm_1_units=(16, 32), lstm_2_units=(8, 16),
+                 batch_size=(32, 64), past_seq_len=(50,)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = training_iteration
+        self.epochs = epochs
+        self.lstm_1_units = list(lstm_1_units)
+        self.lstm_2_units = list(lstm_2_units)
+        self.batch_size = list(batch_size)
+        self.past_seq_len = list(past_seq_len)
+
+    def search_space(self, all_available_features):
+        return {
+            "lstm_units": hp.sample_from(
+                lambda rng: [int(rng.choice(self.lstm_1_units)),
+                             int(rng.choice(self.lstm_2_units))]),
+            "dropouts": hp.uniform(0.1, 0.3),
+            "lr": hp.loguniform(1e-4, 1e-1),
+            "batch_size": hp.grid_search(self.batch_size),
+            "past_seq_len": hp.choice(self.past_seq_len),
+            "loss": "mse",
+        }
+
+    def model_type(self):
+        return "LSTM"
+
+
+class TCNGridRandomRecipe(Recipe):
+    """(reference: recipe.py TCNGridRandomRecipe)"""
+
+    def __init__(self, num_rand_samples: int = 1, training_iteration: int = 10,
+                 num_channels=((16,) * 3,), kernel_size=(3, 5),
+                 batch_size=(32, 64), past_seq_len=(50,)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = training_iteration
+        self.num_channels = [tuple(c) for c in num_channels]
+        self.kernel_size = list(kernel_size)
+        self.batch_size = list(batch_size)
+        self.past_seq_len = list(past_seq_len)
+
+    def search_space(self, all_available_features):
+        return {
+            "num_channels": hp.choice(self.num_channels),
+            "kernel_size": hp.choice(self.kernel_size),
+            "dropout": hp.uniform(0.0, 0.3),
+            "lr": hp.loguniform(1e-4, 1e-2),
+            "batch_size": hp.grid_search(self.batch_size),
+            "past_seq_len": hp.choice(self.past_seq_len),
+            "loss": "mse",
+        }
+
+    def model_type(self):
+        return "TCN"

@@ -1,0 +1,145 @@
+"""Time-series network modules (flax) backing the Zouwu forecasters.
+
+Reference models: LSTM keras graph (pyzoo/zoo/zouwu/model/forecast/
+lstm_forecaster.py:70 + zoo/automl VanillaLSTM), TCN torch impl
+(zouwu/model/tcn.py, dilated causal residual blocks), Seq2Seq keras
+(zouwu/model/Seq2Seq.py). TPU notes: recurrence uses flax's scan-based
+nn.RNN with OptimizedLSTMCell (lax.scan — no Python loops under jit);
+TCN is causal-padded Conv1D stacks, which XLA fuses well and is usually
+the faster pick on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LSTMNet(nn.Module):
+    """Stacked LSTM -> Dense(target_dim). Input (B, T, F) -> (B, target_dim)."""
+    target_dim: int = 1
+    lstm_units: Tuple[int, ...] = (16, 8)
+    dropouts: Tuple[float, ...] = (0.2, 0.2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, units in enumerate(self.lstm_units):
+            rnn = nn.RNN(nn.OptimizedLSTMCell(units), name=f"lstm_{i}")
+            x = rnn(x)
+            rate = self.dropouts[min(i, len(self.dropouts) - 1)]
+            if rate:
+                x = nn.Dropout(rate, deterministic=not train)(x)
+        x = x[:, -1]  # last timestep
+        return nn.Dense(self.target_dim, name="head")(x)
+
+
+class CausalConv1D(nn.Module):
+    channels: int
+    kernel_size: int
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        pad = (self.kernel_size - 1) * self.dilation
+        x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)))
+        return nn.Conv(self.channels, (self.kernel_size,),
+                       kernel_dilation=(self.dilation,), padding="VALID")(x)
+
+
+class TCNBlock(nn.Module):
+    channels: int
+    kernel_size: int
+    dilation: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = CausalConv1D(self.channels, self.kernel_size, self.dilation)(x)
+        y = nn.relu(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        y = CausalConv1D(self.channels, self.kernel_size, self.dilation)(y)
+        y = nn.relu(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        if x.shape[-1] != self.channels:
+            x = nn.Dense(self.channels, name="downsample")(x)
+        return nn.relu(x + y)
+
+
+class TCNNet(nn.Module):
+    """Dilated causal TCN encoder -> linear head mapping the last
+    receptive-field step to (future_seq_len, output_dim).
+    Input (B, past, F) -> (B, future, output_dim)."""
+    past_seq_len: int
+    future_seq_len: int
+    output_feature_num: int = 1
+    num_channels: Tuple[int, ...] = (30,) * 8
+    kernel_size: int = 7
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, ch in enumerate(self.num_channels):
+            x = TCNBlock(ch, self.kernel_size, 2 ** i, self.dropout,
+                         name=f"block_{i}")(x, train=train)
+        last = x[:, -1]
+        out = nn.Dense(self.future_seq_len * self.output_feature_num,
+                       name="head")(last)
+        return out.reshape(out.shape[0], self.future_seq_len,
+                           self.output_feature_num)
+
+
+class Seq2SeqNet(nn.Module):
+    """LSTM encoder-decoder (reference zouwu/model/Seq2Seq.py): encoder folds
+    the past; decoder unrolls future_seq_len steps feeding back its output."""
+    future_seq_len: int
+    output_feature_num: int = 1
+    latent_dim: int = 128
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        enc_cell = nn.OptimizedLSTMCell(self.latent_dim, name="encoder")
+        carry, _ = nn.RNN(enc_cell, return_carry=True,
+                          name="encoder_scan")(x)
+        dec_cell = nn.OptimizedLSTMCell(self.latent_dim, name="decoder")
+        head = nn.Dense(self.output_feature_num, name="head")
+        y = jnp.zeros((B, self.output_feature_num), x.dtype)
+        # static unroll: future_seq_len is a small compile-time constant, and
+        # repeated calls to the same submodules share parameters
+        ys = []
+        for _ in range(self.future_seq_len):
+            carry, h = dec_cell(carry, y)
+            y = head(h)
+            ys.append(y)
+        return jnp.stack(ys, axis=1)
+
+
+class MTNetLite(nn.Module):
+    """Compact MTNet-style forecaster (reference MTNetForecaster wraps the
+    MTNet keras model, zouwu/model/MTNet_keras.py): CNN feature extraction over
+    long/short windows + attention + autoregressive linear path. This lite
+    variant keeps the cnn+ar decomposition (the load-bearing parts) in a
+    jit-friendly form."""
+    target_dim: int = 1
+    ar_window: int = 4
+    cnn_kernel: int = 3
+    cnn_channels: int = 32
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (B, T, F)
+        y = CausalConv1D(self.cnn_channels, self.cnn_kernel)(x)
+        y = nn.relu(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        att = nn.softmax(nn.Dense(1, name="attn")(y), axis=1)  # (B,T,1)
+        ctx = jnp.sum(att * y, axis=1)  # (B,C)
+        nonlinear = nn.Dense(self.target_dim, name="head")(ctx)
+        # autoregressive linear component over the last ar_window steps
+        ar_in = x[:, -self.ar_window:, :].reshape(x.shape[0], -1)
+        linear = nn.Dense(self.target_dim, name="ar")(ar_in)
+        return nonlinear + linear

@@ -1,0 +1,124 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.zouwu.feature.time_sequence import (
+    TimeSequenceFeatureTransformer, roll_windows)
+
+
+def make_series(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(n)
+    value = np.sin(t / 10.0) + 0.05 * rng.randn(n)
+    return pd.DataFrame({
+        "datetime": pd.date_range("2020-01-01", periods=n, freq="h"),
+        "value": value.astype(np.float32)})
+
+
+def test_roll_windows():
+    arr = np.arange(20, dtype=np.float32).reshape(10, 2)
+    x, y = roll_windows(arr, past=4, horizon=2)
+    assert x.shape == (5, 4, 2)
+    assert y.shape == (5, 2)
+    np.testing.assert_array_equal(y[0], [8, 10])  # col 0 at t=4,5
+
+
+def test_feature_transformer():
+    df = make_series(100)
+    tsft = TimeSequenceFeatureTransformer(horizon=2, dt_col="datetime",
+                                          target_col="value")
+    x, y = tsft.fit_transform(df, past_seq_len=10)
+    assert x.shape[1:] == (10, tsft.feature_num)
+    assert y.shape[1] == 2
+    x2, y2 = tsft.transform(df, is_train=True)
+    np.testing.assert_allclose(x, x2, rtol=1e-5)
+    inv = tsft.inverse_transform_y(tsft.scale_y(np.array([1.5])))
+    np.testing.assert_allclose(inv, [1.5], rtol=1e-5)
+
+
+def test_lstm_forecaster(orca_context):
+    from analytics_zoo_tpu.zouwu import LSTMForecaster
+    df = make_series(300)
+    tsft = TimeSequenceFeatureTransformer(horizon=1, dt_col="datetime",
+                                          target_col="value")
+    x, y = tsft.fit_transform(df, past_seq_len=16)
+    f = LSTMForecaster(target_dim=1, feature_dim=tsft.feature_num,
+                       lstm_units=(16, 8), lr=0.01)
+    f.fit(x, y, epochs=6, batch_size=32)
+    res = f.evaluate(x, y, metrics=["mse", "smape"])
+    assert res["mse"] < 0.3, res
+    pred = f.predict(x[:5])
+    assert pred.shape == (5, 1)
+
+
+def test_tcn_forecaster(orca_context):
+    from analytics_zoo_tpu.zouwu import TCNForecaster
+    df = make_series(300)
+    tsft = TimeSequenceFeatureTransformer(horizon=4, dt_col="datetime",
+                                          target_col="value")
+    x, y = tsft.fit_transform(df, past_seq_len=24)
+    f = TCNForecaster(past_seq_len=24, future_seq_len=4,
+                      input_feature_num=tsft.feature_num,
+                      output_feature_num=1, num_channels=(8, 8, 8),
+                      kernel_size=3, lr=0.01)
+    f.fit(x, y[..., None], epochs=6, batch_size=32)
+    res = f.evaluate(x, y[..., None], metrics=["mse"])
+    assert res["mse"] < 0.4, res
+    with pytest.raises(AssertionError):
+        f._check_data(x[:, :5], y[..., None])
+
+
+def test_seq2seq_forecaster(orca_context):
+    from analytics_zoo_tpu.zouwu import Seq2SeqForecaster
+    df = make_series(200)
+    tsft = TimeSequenceFeatureTransformer(horizon=3, dt_col="datetime",
+                                          target_col="value")
+    x, y = tsft.fit_transform(df, past_seq_len=12)
+    f = Seq2SeqForecaster(past_seq_len=12, future_seq_len=3,
+                          input_feature_num=tsft.feature_num,
+                          output_feature_num=1, lstm_hidden_dim=16, lr=0.01)
+    f.fit(x, y[..., None], epochs=4, batch_size=32)
+    pred = f.predict(x[:4])
+    assert pred.shape == (4, 3, 1)
+
+
+def test_threshold_detector():
+    from analytics_zoo_tpu.zouwu.model import ThresholdDetector
+    rng = np.random.RandomState(0)
+    y = rng.randn(200).astype(np.float32) * 0.1
+    y[50] = 5.0
+    y[120] = -4.0
+    det = ThresholdDetector().set_params(ratio=0.02)
+    idx = det.detect(y)
+    assert 50 in idx and 120 in idx
+
+
+def test_ae_detector(orca_context):
+    from analytics_zoo_tpu.zouwu.model import AEDetector
+    rng = np.random.RandomState(0)
+    t = np.arange(300)
+    y = np.sin(t / 5.0).astype(np.float32)
+    y[150:153] += 4.0  # injected anomaly
+    det = AEDetector(roll_len=10, ratio=0.05, epochs=10)
+    idx = det.detect(y)
+    assert any(145 <= i <= 160 for i in idx), idx
+
+
+def test_autots_pipeline(orca_context, tmp_path):
+    from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
+    from analytics_zoo_tpu.zouwu.config import SmokeRecipe
+
+    df = make_series(250)
+    trainer = AutoTSTrainer(dt_col="datetime", target_col="value", horizon=1)
+    pipeline = trainer.fit(df, validation_df=make_series(120, seed=1),
+                           recipe=SmokeRecipe())
+    res = pipeline.evaluate(make_series(120, seed=2), metrics=["mse"])
+    assert np.isfinite(res["mse"])
+    pred_df = pipeline.predict(make_series(60, seed=3))
+    assert "value" in pred_df.columns
+
+    path = str(tmp_path / "ts.pipeline")
+    pipeline.save(path)
+    loaded = TSPipeline.load(path)
+    res2 = loaded.evaluate(make_series(120, seed=2), metrics=["mse"])
+    np.testing.assert_allclose(res2["mse"], res["mse"], rtol=1e-4)
