@@ -1,0 +1,213 @@
+"""Fused attention ops: reference MHA, a Pallas TPU flash-attention kernel,
+and the blockwise-softmax update that ring attention builds on.
+
+The reference framework's attention is plain materialised-scores attention
+inside its BERT/Transformer layers (reference: pyzoo/zoo/pipeline/api/keras/
+layers/self_attention.py:386, zoo/.../keras/layers/BERT.scala:402) and it has
+no long-context path at all (SURVEY.md §2.3). Here attention is a first-class
+op: the flash kernel keeps scores in VMEM a (block_q, block_k) tile at a time
+so the MXU stays busy and HBM never sees the S×S matrix.
+
+Shapes follow (batch, seq, heads, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  *, causal: bool = False, sm_scale: Optional[float] = None,
+                  bias: Optional[jax.Array] = None) -> jax.Array:
+    """Plain materialised-scores attention. q,k,v: (B, S, H, D)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def blockwise_update(q, k_blk, v_blk, acc, m, l, *, sm_scale,
+                     q_positions=None, k_positions=None, causal=False):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: (B, Sq, H, D); k_blk/v_blk: (B, Sk, H, D); acc: (B, Sq, H, D) f32;
+    m, l: (B, Sq, H) f32 running max / normaliser. Returns updated (acc, m, l).
+    This is the building block shared by ring attention
+    (parallel/ring_attention.py) and any host-side blockwise fallback.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * sm_scale
+    if causal:
+        if q_positions is None:
+            q_positions = jnp.arange(q.shape[1])
+        if k_positions is None:
+            k_positions = jnp.arange(k_blk.shape[1])
+        mask = q_positions[:, None] >= k_positions[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_bhq = jnp.moveaxis(m, -1, 1)                       # (B, H, Sq)
+    m_new = jnp.maximum(m_bhq, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m_bhq - m_new)                  # (B, H, Sq)
+    l_new = jnp.moveaxis(l, -1, 1) * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+    acc_new = acc * jnp.moveaxis(correction, 1, -1)[..., None] + pv
+    return acc_new, jnp.moveaxis(m_new, 1, -1), jnp.moveaxis(l_new, 1, -1)
+
+
+def blockwise_finalize(acc, l):
+    """Normalise the accumulator once all K/V blocks are folded in."""
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash-attention kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  sm_scale, block_q, block_k, num_k_blocks, causal):
+    """Grid = (batch*heads, num_q_blocks, num_k_blocks); the k dim is innermost
+    so (acc, m, l) scratch carries the online softmax across k iterations."""
+    import jax.experimental.pallas as pl  # local import keeps module cpu-safe
+
+    q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = q_idx * block_q
+    k_start = k_idx * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                 # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)                 # (block_k, D)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[:, :1]                            # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                           # (block_q, block_k)
+        correction = jnp.exp(m_prev - m_new)             # (block_q, 1)
+        l_ref[...] = (l_ref[...] * correction +
+                      jnp.sum(p, axis=-1, keepdims=True))
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        v = v_ref[0].astype(jnp.float32)
+        acc_ref[...] = (acc_ref[...] * correction +
+                        jnp.dot(p, v, preferred_element_type=jnp.float32))
+
+    if causal:
+        # Skip fully-masked tiles: every q in the tile is before every k.
+        pl.when(q_start + block_q - 1 >= k_start)(_compute)
+    else:
+        _compute()
+
+    @pl.when(k_idx == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    # (B, S, H, D) -> (B*H, S, D): each grid row owns one head's sequence.
+    qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
+    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
+    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    num_q = s_q // block_q
+    num_k = s_k // block_k
+
+    grid = (b * h, num_q, num_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        num_k_blocks=num_k, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k):
+    interpret = not _on_tpu()
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    # Recompute-based backward: XLA re-fuses the score matrix per tile; for
+    # very long sequences the ring path (parallel/ring_attention.py) keeps
+    # the working set at S_local per device instead.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
+                                         sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Flash attention over (B, S, H, D). Uses the Pallas kernel when the
+    sequence tiles evenly (interpret mode off-TPU), else the reference path."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s_q, s_k = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, s_q), min(block_k, s_k)
+    if s_q % bq or s_k % bk or (causal and s_q != s_k):
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
