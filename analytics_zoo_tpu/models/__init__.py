@@ -1,0 +1,13 @@
+from .anomalydetection import AnomalyDetector, AnomalyDetectorNet
+from .recommendation import (ColumnFeatureInfo, NeuralCF, NeuralCFNet,
+                             SessionRecommender, SessionRecommenderNet,
+                             WideAndDeep, WideAndDeepNet)
+from .seq2seq import Seq2Seq, Seq2SeqNet
+from .textclassification import TextClassifier, TextClassifierNet
+from .textmatching import KNRM, KNRMNet
+
+__all__ = ["AnomalyDetector", "AnomalyDetectorNet", "ColumnFeatureInfo",
+           "NeuralCF", "NeuralCFNet", "SessionRecommender",
+           "SessionRecommenderNet", "WideAndDeep", "WideAndDeepNet",
+           "Seq2Seq", "Seq2SeqNet", "TextClassifier", "TextClassifierNet",
+           "KNRM", "KNRMNet"]

@@ -1,0 +1,1 @@
+from .anomaly_detector import AnomalyDetector, AnomalyDetectorNet

@@ -1,0 +1,69 @@
+"""AnomalyDetector (parity: pyzoo/zoo/models/anomalydetection/
+anomaly_detector.py:30; Scala AnomalyDetector.scala:222): stacked LSTMs with
+dropout predicting the next value of a time series; anomalies are the points
+with the largest prediction error."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.zoo_model import ZooModel
+
+
+class AnomalyDetectorNet(nn.Module):
+    feature_shape: Tuple[int, int] = (10, 1)     # (unroll_length, n_features)
+    hidden_layers: Tuple[int, ...] = (8, 32, 15)
+    dropouts: Tuple[float, ...] = (0.2, 0.2, 0.2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x
+        n = len(self.hidden_layers)
+        for i, (units, drop) in enumerate(zip(self.hidden_layers,
+                                              self.dropouts)):
+            h = nn.RNN(nn.LSTMCell(features=units), name=f"lstm_{i}")(h)
+            if i == n - 1:
+                h = h[:, -1, :]
+            h = nn.Dropout(drop, deterministic=not train)(h)
+        return nn.Dense(1, name="head")(h)
+
+
+class AnomalyDetector(ZooModel):
+    def __init__(self, feature_shape, hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2), **_):
+        assert len(hidden_layers) == len(dropouts), \
+            "sizes of dropouts and hidden_layers should be equal"
+        module = AnomalyDetectorNet(
+            feature_shape=tuple(int(d) for d in feature_shape),
+            hidden_layers=tuple(int(u) for u in hidden_layers),
+            dropouts=tuple(float(d) for d in dropouts))
+        super().__init__(module)
+
+    # --- reference helpers --------------------------------------------------
+    @staticmethod
+    def unroll(data: np.ndarray, unroll_length: int, predict_step: int = 1):
+        """reference anomaly_detector.py unroll: sliding windows + target."""
+        data = np.asarray(data)
+        xs, ys = [], []
+        for i in range(len(data) - unroll_length - predict_step + 1):
+            xs.append(data[i:i + unroll_length])
+            ys.append(data[i + unroll_length + predict_step - 1, 0]
+                      if data.ndim > 1 else
+                      data[i + unroll_length + predict_step - 1])
+        return np.stack(xs), np.asarray(ys, np.float32)
+
+    @staticmethod
+    def detect_anomalies(y_true: np.ndarray, y_pred: np.ndarray,
+                         anomaly_size: int):
+        """Top-`anomaly_size` absolute errors are anomalies (reference
+        detectAnomalies)."""
+        y_true = np.asarray(y_true).reshape(-1)
+        y_pred = np.asarray(y_pred).reshape(-1)
+        err = np.abs(y_true - y_pred)
+        th = np.sort(err)[-anomaly_size] if anomaly_size > 0 else np.inf
+        idx = np.where(err >= th)[0]
+        return [(int(i), float(y_true[i]), float(y_pred[i])) for i in idx]

@@ -1,0 +1,77 @@
+"""SessionRecommender (parity: pyzoo/zoo/models/recommendation/
+session_recommender.py:30; Scala SessionRecommender.scala:209): GRU over the
+session item sequence, optional MLP over purchase history, softmax over the
+item catalog."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.zoo_model import ZooModel
+
+
+class SessionRecommenderNet(nn.Module):
+    item_count: int
+    item_embed: int = 100
+    rnn_hidden_layers: Tuple[int, ...] = (40, 20)
+    session_length: int = 5
+    include_history: bool = False
+    mlp_hidden_layers: Tuple[int, ...] = (40, 20)
+    history_length: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        """x: (batch, session_length) item ids, or with history
+        (batch, session_length + history_length)."""
+        ids = x.astype(jnp.int32)
+        sess = ids[:, :self.session_length]
+        emb = nn.Embed(self.item_count + 1, self.item_embed,
+                       name="item_embedding")(jnp.clip(sess, 0,
+                                                       self.item_count))
+        h = emb
+        for k, units in enumerate(self.rnn_hidden_layers):
+            h = nn.RNN(nn.GRUCell(features=units), name=f"gru_{k}")(h)
+        rnn_out = h[:, -1, :]
+        logits = nn.Dense(self.item_count + 1, name="rnn_head")(rnn_out)
+        if self.include_history:
+            hist = ids[:, self.session_length:
+                       self.session_length + self.history_length]
+            hemb = nn.Embed(self.item_count + 1, self.item_embed,
+                            name="hist_embedding")(
+                jnp.clip(hist, 0, self.item_count))
+            hmean = jnp.mean(hemb, axis=1)
+            m = hmean
+            for k, units in enumerate(self.mlp_hidden_layers):
+                m = nn.relu(nn.Dense(units, name=f"mlp_{k}")(m))
+            logits = logits + nn.Dense(self.item_count + 1,
+                                       name="mlp_head")(m)
+        return nn.softmax(logits)
+
+
+class SessionRecommender(ZooModel):
+    def __init__(self, item_count, item_embed=100,
+                 rnn_hidden_layers: Sequence[int] = (40, 20),
+                 session_length: int = 0, include_history: bool = False,
+                 mlp_hidden_layers: Sequence[int] = (40, 20),
+                 history_length: int = 0, **_):
+        module = SessionRecommenderNet(
+            item_count=int(item_count), item_embed=int(item_embed),
+            rnn_hidden_layers=tuple(int(u) for u in rnn_hidden_layers),
+            session_length=int(session_length),
+            include_history=include_history,
+            mlp_hidden_layers=tuple(int(u) for u in mlp_hidden_layers),
+            history_length=int(history_length))
+        super().__init__(module)
+
+    def recommend_for_session(self, sessions: np.ndarray, max_items: int = 5,
+                              zero_based_label: bool = True):
+        probs = np.asarray(self.predict(np.asarray(sessions)))
+        top = np.argsort(-probs, axis=-1)[:, :max_items]
+        if not zero_based_label:
+            top = top + 1
+        return [list(zip(row, probs[i, row]))
+                for i, row in enumerate(top)]

@@ -1,0 +1,120 @@
+"""Wide & Deep recommender (parity: pyzoo/zoo/models/recommendation/
+wide_and_deep.py:94 ColumnFeatureInfo/WideAndDeep; Scala
+zoo/.../models/recommendation/WideAndDeep.scala:365).
+
+The wide branch is a (sparse in spirit, dense in math) linear map over the
+one/multi-hot wide columns; the deep branch embeds categorical columns and
+concatenates indicator + continuous features. Input layout mirrors the
+reference's concatenated tensor: [wide | indicator | embed_ids | continuous].
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.zoo_model import ZooModel
+
+
+class ColumnFeatureInfo:
+    """reference wide_and_deep.py:60 — plain config holder."""
+
+    def __init__(self, wide_base_cols=None, wide_base_dims=None,
+                 wide_cross_cols=None, wide_cross_dims=None,
+                 indicator_cols=None, indicator_dims=None, embed_cols=None,
+                 embed_in_dims=None, embed_out_dims=None,
+                 continuous_cols=None, label="label", **_):
+        self.wide_base_cols = list(wide_base_cols or [])
+        self.wide_base_dims = [int(d) for d in (wide_base_dims or [])]
+        self.wide_cross_cols = list(wide_cross_cols or [])
+        self.wide_cross_dims = [int(d) for d in (wide_cross_dims or [])]
+        self.indicator_cols = list(indicator_cols or [])
+        self.indicator_dims = [int(d) for d in (indicator_dims or [])]
+        self.embed_cols = list(embed_cols or [])
+        self.embed_in_dims = [int(d) for d in (embed_in_dims or [])]
+        self.embed_out_dims = [int(d) for d in (embed_out_dims or [])]
+        self.continuous_cols = list(continuous_cols or [])
+        self.label = label
+
+    @property
+    def wide_dim(self) -> int:
+        return sum(self.wide_base_dims) + sum(self.wide_cross_dims)
+
+    @property
+    def indicator_dim(self) -> int:
+        return sum(self.indicator_dims)
+
+    def feature_width(self) -> int:
+        return (self.wide_dim + self.indicator_dim +
+                len(self.embed_in_dims) + len(self.continuous_cols))
+
+
+class WideAndDeepNet(nn.Module):
+    class_num: int
+    model_type: str = "wide_n_deep"
+    wide_dim: int = 0
+    indicator_dim: int = 0
+    embed_in_dims: Tuple[int, ...] = ()
+    embed_out_dims: Tuple[int, ...] = ()
+    continuous_count: int = 0
+    hidden_layers: Tuple[int, ...] = (40, 20, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        ofs = 0
+        wide = x[:, ofs:ofs + self.wide_dim]
+        ofs += self.wide_dim
+        indicator = x[:, ofs:ofs + self.indicator_dim]
+        ofs += self.indicator_dim
+        embed_ids = x[:, ofs:ofs + len(self.embed_in_dims)]
+        ofs += len(self.embed_in_dims)
+        continuous = x[:, ofs:ofs + self.continuous_count]
+
+        logits = 0.0
+        if self.model_type in ("wide", "wide_n_deep"):
+            logits = logits + nn.Dense(self.class_num, use_bias=True,
+                                       name="wide_linear")(wide)
+        if self.model_type in ("deep", "wide_n_deep"):
+            parts = []
+            if self.indicator_dim:
+                parts.append(indicator)
+            for i, (in_dim, out_dim) in enumerate(
+                    zip(self.embed_in_dims, self.embed_out_dims)):
+                ids = embed_ids[:, i].astype(jnp.int32)
+                emb = nn.Embed(in_dim + 1, out_dim,
+                               name=f"embed_{i}")(jnp.clip(ids, 0, in_dim))
+                parts.append(emb)
+            if self.continuous_count:
+                parts.append(continuous)
+            h = jnp.concatenate(parts, axis=-1)
+            for k, units in enumerate(self.hidden_layers):
+                h = nn.relu(nn.Dense(units, name=f"deep_dense_{k}")(h))
+            logits = logits + nn.Dense(self.class_num, name="deep_head")(h)
+        return nn.softmax(logits)
+
+
+class WideAndDeep(ZooModel):
+    """reference wide_and_deep.py:94 WideAndDeep(class_num, column_info,
+    model_type, hidden_layers)."""
+
+    def __init__(self, class_num, column_info: ColumnFeatureInfo,
+                 model_type: str = "wide_n_deep",
+                 hidden_layers: Sequence[int] = (40, 20, 10), **_):
+        assert model_type in ("wide", "deep", "wide_n_deep")
+        self.column_info = column_info
+        module = WideAndDeepNet(
+            class_num=int(class_num), model_type=model_type,
+            wide_dim=column_info.wide_dim,
+            indicator_dim=column_info.indicator_dim,
+            embed_in_dims=tuple(column_info.embed_in_dims),
+            embed_out_dims=tuple(column_info.embed_out_dims),
+            continuous_count=len(column_info.continuous_cols),
+            hidden_layers=tuple(int(u) for u in hidden_layers))
+        super().__init__(module)
+
+    def recommend_for_user(self, user_item_pairs, max_items: int = 5):
+        from .neuralcf import NeuralCF
+        return NeuralCF.recommend_for_user(self, user_item_pairs, max_items)

@@ -1,0 +1,1 @@
+from .seq2seq import RNNDecoder, RNNEncoder, Seq2Seq, Seq2SeqNet

@@ -1,0 +1,156 @@
+"""Seq2Seq encoder-decoder (parity: pyzoo/zoo/models/seq2seq/seq2seq.py
+RNNEncoder/RNNDecoder/Bridge/Seq2Seq; Scala models/seq2seq/Seq2seq.scala:302).
+
+Teacher-forced training: __call__(src_ids, tgt_inputs) -> per-step logits.
+Greedy inference via ``infer`` mirrors the reference's Seq2Seq.infer loop, as
+a lax.scan so generation stays on-device."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.zoo_model import ZooModel
+
+
+def _make_cell(rnn_type: str, hidden: int):
+    t = rnn_type.lower()
+    if t == "lstm":
+        return nn.LSTMCell(features=hidden)
+    if t == "gru":
+        return nn.GRUCell(features=hidden)
+    if t == "simplernn":
+        return nn.SimpleCell(features=hidden)
+    raise ValueError(f"unsupported rnn_type {rnn_type!r}")
+
+
+class RNNEncoder(nn.Module):
+    """reference seq2seq.py RNNEncoder.initialize(rnn_type, nlayers,
+    hidden_size, embedding)."""
+    rnn_type: str = "lstm"
+    nlayers: int = 1
+    hidden_size: int = 128
+    vocab_size: int = 0            # 0 = inputs are already vectors
+    embed_dim: int = 0
+
+    @nn.compact
+    def __call__(self, x):
+        if self.vocab_size:
+            x = nn.Embed(self.vocab_size, self.embed_dim or self.hidden_size,
+                         name="embedding")(x.astype(jnp.int32))
+        carries = []
+        h = x
+        for i in range(self.nlayers):
+            cell = _make_cell(self.rnn_type, self.hidden_size)
+            carry, h = nn.RNN(cell, name=f"rnn_{i}",
+                              return_carry=True)(h)
+            carries.append(carry)
+        return h, carries
+
+
+class RNNDecoder(nn.Module):
+    """reference seq2seq.py RNNDecoder — same stack, initialised from the
+    encoder's final states."""
+    rnn_type: str = "lstm"
+    nlayers: int = 1
+    hidden_size: int = 128
+    vocab_size: int = 0
+    embed_dim: int = 0
+
+    @nn.compact
+    def __call__(self, y, init_carries):
+        if self.vocab_size:
+            y = nn.Embed(self.vocab_size, self.embed_dim or self.hidden_size,
+                         name="embedding")(y.astype(jnp.int32))
+        h = y
+        for i in range(self.nlayers):
+            cell = _make_cell(self.rnn_type, self.hidden_size)
+            h = nn.RNN(cell, name=f"rnn_{i}")(
+                h, initial_carry=init_carries[i])
+        return h
+
+
+class Seq2SeqNet(nn.Module):
+    rnn_type: str = "lstm"
+    nlayers: int = 1
+    hidden_size: int = 128
+    src_vocab: int = 0
+    tgt_vocab: int = 0
+    embed_dim: int = 0
+    bridge: str = "passthrough"     # reference Bridge: passthrough | dense
+
+    def setup(self):
+        self.encoder = RNNEncoder(rnn_type=self.rnn_type,
+                                  nlayers=self.nlayers,
+                                  hidden_size=self.hidden_size,
+                                  vocab_size=self.src_vocab,
+                                  embed_dim=self.embed_dim)
+        self.decoder = RNNDecoder(rnn_type=self.rnn_type,
+                                  nlayers=self.nlayers,
+                                  hidden_size=self.hidden_size,
+                                  vocab_size=self.tgt_vocab,
+                                  embed_dim=self.embed_dim)
+        if self.bridge == "dense":
+            self.bridge_dense = nn.Dense(self.hidden_size)
+        if self.tgt_vocab:
+            self.generator = nn.Dense(self.tgt_vocab)
+
+    def _bridge(self, carries):
+        if self.bridge == "passthrough":
+            return carries
+        return jax.tree.map(lambda c: self.bridge_dense(c), carries)
+
+    def __call__(self, src, tgt):
+        _, carries = self.encoder(src)
+        out = self.decoder(tgt, self._bridge(carries))
+        if self.tgt_vocab:
+            return self.generator(out)
+        return out
+
+
+class Seq2Seq(ZooModel):
+    """reference seq2seq.py Seq2Seq(encoder, decoder, input_shape,
+    output_shape, bridge, generator) — condensed constructor; data is
+    {'x': (src, tgt_in), 'y': tgt_out}."""
+
+    def __init__(self, rnn_type="lstm", nlayers=1, hidden_size=128,
+                 src_vocab=0, tgt_vocab=0, embed_dim=0,
+                 bridge="passthrough", **_):
+        module = Seq2SeqNet(rnn_type=rnn_type, nlayers=int(nlayers),
+                            hidden_size=int(hidden_size),
+                            src_vocab=int(src_vocab),
+                            tgt_vocab=int(tgt_vocab),
+                            embed_dim=int(embed_dim), bridge=bridge)
+        super().__init__(module)
+
+    def infer(self, src: np.ndarray, start_sign: int, max_seq_len: int = 30,
+              stop_sign: Optional[int] = None):
+        """Greedy decode (reference Seq2Seq.infer). Returns int ids
+        (batch, max_seq_len)."""
+        engine = self.estimator.engine
+        params = engine.params
+        module: Seq2SeqNet = self.module
+        src = jnp.asarray(src)
+
+        def run(params, src):
+            # Re-decode the growing prefix each step (O(L^2) but
+            # static-shaped, so XLA compiles one program); fine for the
+            # reference's short max_seq_len inference loop.
+            b = src.shape[0]
+            tokens = jnp.full((b, max_seq_len), start_sign, jnp.int32)
+
+            def body(i, tokens):
+                logits = module.apply({"params": params}, src, tokens)
+                nxt = jnp.argmax(logits[:, i], -1).astype(jnp.int32)
+                return tokens.at[:, jnp.minimum(i + 1, max_seq_len - 1)].set(
+                    jnp.where(i + 1 < max_seq_len, nxt,
+                              tokens[:, max_seq_len - 1]))
+
+            tokens = jax.lax.fori_loop(0, max_seq_len - 1, body, tokens)
+            return tokens
+
+        return np.asarray(jax.jit(run)(params, src))

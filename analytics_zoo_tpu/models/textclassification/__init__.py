@@ -1,0 +1,1 @@
+from .text_classifier import TextClassifier, TextClassifierNet

@@ -1,0 +1,80 @@
+"""TextClassifier (parity: pyzoo/zoo/models/textclassification/
+text_classifier.py:29 — WordEmbedding first layer + cnn/lstm/gru encoder +
+dense head). Embedding comes from a matrix or a GloVe path rather than the
+reference's JVM-side GloVe loader."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.zoo_model import ZooModel
+
+
+class TextClassifierNet(nn.Module):
+    class_num: int
+    vocab_size: int = 0
+    embed_dim: int = 200
+    embedding_matrix: Any = None       # optional pretrained (frozen) matrix
+    sequence_length: int = 500
+    encoder: str = "cnn"
+    encoder_output_dim: int = 256
+
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        ids = ids.astype(jnp.int32)
+        if self.embedding_matrix is not None:
+            mat = np.asarray(self.embedding_matrix, np.float32)
+            table = self.param("embedding",
+                               lambda rng, s=mat.shape: jnp.asarray(mat),
+                               mat.shape)
+            x = jax.lax.stop_gradient(table)[ids]
+        else:
+            x = nn.Embed(self.vocab_size, self.embed_dim,
+                         name="embedding")(ids)
+        enc = self.encoder.lower()
+        if enc == "cnn":
+            h = nn.Conv(self.encoder_output_dim, (5,), padding="VALID",
+                        name="conv")(x)
+            h = nn.relu(h)
+            h = jnp.max(h, axis=1)
+        elif enc == "lstm":
+            h = nn.RNN(nn.LSTMCell(features=self.encoder_output_dim))(x)
+            h = h[:, -1, :]
+        elif enc == "gru":
+            h = nn.RNN(nn.GRUCell(features=self.encoder_output_dim))(x)
+            h = h[:, -1, :]
+        else:
+            raise ValueError(f"unsupported encoder {self.encoder!r}")
+        h = nn.Dropout(0.2, deterministic=not train)(h)
+        h = nn.relu(nn.Dense(128, name="fc")(h))
+        logits = nn.Dense(self.class_num, name="head")(h)
+        return nn.softmax(logits)
+
+
+class TextClassifier(ZooModel):
+    """Constructor mirrors the reference: TextClassifier(class_num,
+    embedding_file, word_index, sequence_length, encoder,
+    encoder_output_dim)."""
+
+    def __init__(self, class_num, embedding_file: Optional[str] = None,
+                 word_index: Optional[dict] = None, sequence_length: int = 500,
+                 encoder: str = "cnn", encoder_output_dim: int = 256,
+                 vocab_size: int = 20000, embed_dim: int = 200,
+                 embedding_matrix=None, **_):
+        if embedding_file is not None and embedding_matrix is None:
+            from analytics_zoo_tpu.pipeline.api.keras.layers import \
+                WordEmbedding
+            embedding_matrix = WordEmbedding.from_glove(
+                embedding_file, word_index).embedding_matrix
+        module = TextClassifierNet(
+            class_num=int(class_num),
+            vocab_size=int(vocab_size), embed_dim=int(embed_dim),
+            embedding_matrix=embedding_matrix,
+            sequence_length=int(sequence_length), encoder=encoder,
+            encoder_output_dim=int(encoder_output_dim))
+        super().__init__(module)

@@ -1,0 +1,1 @@
+from .knrm import KNRM, KNRMNet

@@ -1,0 +1,110 @@
+"""KNRM kernel-pooling ranking model (parity: pyzoo/zoo/models/textmatching/
+knrm.py:32, Scala zoo/.../models/textmatching/KNRM.scala:192; paper
+arXiv:1706.06613).
+
+Input is the reference's packed layout: (batch, text1_length + text2_length)
+int ids — query ids then doc ids. The translation-matrix + RBF kernel pooling
+is a handful of einsums/exps that XLA fuses into one kernel."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.zoo_model import ZooModel
+
+
+class KNRMNet(nn.Module):
+    text1_length: int
+    text2_length: int
+    vocab_size: int = 0
+    embed_size: int = 300
+    embedding_matrix: Any = None
+    train_embed: bool = True
+    kernel_num: int = 21
+    sigma: float = 0.1
+    exact_sigma: float = 0.001
+    target_mode: str = "ranking"
+
+    @nn.compact
+    def __call__(self, ids):
+        ids = ids.astype(jnp.int32)
+        q_ids = ids[:, :self.text1_length]
+        d_ids = ids[:, self.text1_length:
+                    self.text1_length + self.text2_length]
+        if self.embedding_matrix is not None:
+            mat = np.asarray(self.embedding_matrix, np.float32)
+            table = self.param("embedding",
+                               lambda rng: jnp.asarray(mat), mat.shape)
+        else:
+            table = self.param("embedding",
+                               nn.initializers.uniform(scale=0.1),
+                               (self.vocab_size, self.embed_size))
+        if not self.train_embed:
+            table = jax.lax.stop_gradient(table)
+        q = table[q_ids]                           # (b, L1, E)
+        d = table[d_ids]                           # (b, L2, E)
+        # cosine translation matrix
+        qn = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
+                             1e-12)
+        dn = d / jnp.maximum(jnp.linalg.norm(d, axis=-1, keepdims=True),
+                             1e-12)
+        trans = jnp.einsum("bqe,bde->bqd", qn, dn)  # (b, L1, L2)
+        # RBF kernels: mu from -0.9..1.0; the mu=1.0 kernel uses exact_sigma
+        # (reference KNRM.scala kernel construction)
+        k = self.kernel_num
+        mus, sigmas = [], []
+        for i in range(k):
+            mu = 1.0 - 2.0 * i / (k - 1)
+            mus.append(mu)
+            sigmas.append(self.exact_sigma if i == 0 else self.sigma)
+        mus = jnp.asarray(mus)                     # (K,)
+        sigmas = jnp.asarray(sigmas)
+        diff = trans[..., None] - mus              # (b, L1, L2, K)
+        kernels = jnp.exp(-0.5 * jnp.square(diff) / jnp.square(sigmas))
+        soft_tf = jnp.sum(kernels, axis=2)         # (b, L1, K)
+        log_k = jnp.log(jnp.maximum(soft_tf, 1e-10)) * 0.01
+        phi = jnp.sum(log_k, axis=1)               # (b, K)
+        score = nn.Dense(1, name="ranker")(phi)
+        if self.target_mode == "classification":
+            return jax.nn.sigmoid(score)
+        return score
+
+
+class KNRM(ZooModel):
+    def __init__(self, text1_length, text2_length,
+                 embedding_file: Optional[str] = None,
+                 word_index: Optional[dict] = None, train_embed: bool = True,
+                 kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001, target_mode: str = "ranking",
+                 vocab_size: int = 20000, embed_size: int = 300,
+                 embedding_matrix=None, **_):
+        if embedding_file is not None and embedding_matrix is None:
+            from analytics_zoo_tpu.pipeline.api.keras.layers import \
+                WordEmbedding
+            embedding_matrix = WordEmbedding.from_glove(
+                embedding_file, word_index).embedding_matrix
+        if embedding_matrix is not None:
+            vocab_size, embed_size = np.asarray(embedding_matrix).shape
+        module = KNRMNet(
+            text1_length=int(text1_length), text2_length=int(text2_length),
+            vocab_size=int(vocab_size), embed_size=int(embed_size),
+            embedding_matrix=embedding_matrix, train_embed=train_embed,
+            kernel_num=int(kernel_num), sigma=float(sigma),
+            exact_sigma=float(exact_sigma), target_mode=target_mode)
+        super().__init__(module)
+
+    # ranking metrics (reference models/common/ranker.py Ranker)
+    def evaluate_ndcg(self, x, y, k: int = 10):
+        from ..common.ranker import ndcg
+        scores = np.asarray(self.predict(x)).reshape(-1)
+        return ndcg(np.asarray(y).reshape(-1), scores, k)
+
+    def evaluate_map(self, x, y):
+        from ..common.ranker import mean_average_precision
+        scores = np.asarray(self.predict(x)).reshape(-1)
+        return mean_average_precision(np.asarray(y).reshape(-1), scores)

@@ -123,7 +123,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(k_idx == num_k_blocks - 1)
     def _finalize():
         o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+                    jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -146,6 +146,17 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
         num_k_blocks=num_k, causal=causal)
+    # Under shard_map (e.g. Ulysses sequence parallelism) the output must
+    # declare which mesh axes it varies over. Use the union of the inputs'
+    # varying sets and lift any less-varying input up to it so mixed-vma
+    # call sites (e.g. cross-attention with replicated q) still compile.
+    vma = frozenset()
+    for a in (qf, kf, vf):
+        vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+    if vma:
+        qf, kf, vf = (jax.lax.pvary(
+            a, tuple(vma - (getattr(jax.typeof(a), "vma", None) or
+                            frozenset()))) for a in (qf, kf, vf))
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -155,7 +166,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype, vma=vma),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -210,4 +221,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     bq, bk = min(block_q, s_q), min(block_k, s_k)
     if s_q % bq or s_k % bk or (causal and s_q != s_k):
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if not _on_tpu():
+        vma = frozenset()
+        for a in (q, k, v):
+            vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+        if vma:
+            # Interpret-mode pallas under shard_map is unreliable in jax
+            # 0.9: the HLO interpreter's grid dynamic_slice rejects
+            # varying operands with invariant indices for some (non-causal)
+            # shapes. On-TPU the kernel path handles vma via the union
+            # logic in _flash_forward; off-TPU use the reference math.
+            return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash_attention(q, k, v, causal, sm_scale, block_q, block_k)

@@ -49,9 +49,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     b, s_local, h, d = q.shape
 
     q_positions = idx * s_local + jnp.arange(s_local)
-    acc = jnp.zeros((b, s_local, h, d), jnp.float32)
-    m = jnp.full((b, s_local, h), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, s_local, h), jnp.float32)
+    # Accumulators must carry the inputs' varying-axes type (jax >= 0.9
+    # shard_map vma typing) or the scan carry is rejected; pvary marks the
+    # device-invariant zeros as varying over every manual axis in scope.
+    vma = tuple(getattr(jax.typeof(q), "vma", ()) |
+                getattr(jax.typeof(k), "vma", frozenset()))
+    acc = lax.pvary(jnp.zeros((b, s_local, h, d), jnp.float32), vma)
+    m = lax.pvary(jnp.full((b, s_local, h), -jnp.inf, jnp.float32), vma)
+    l = lax.pvary(jnp.zeros((b, s_local, h), jnp.float32), vma)
 
     def step(carry, i):
         k_blk, v_blk, acc, m, l = carry

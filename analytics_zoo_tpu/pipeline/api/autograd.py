@@ -1,0 +1,214 @@
+"""Symbolic autograd API (parity: pyzoo/zoo/pipeline/api/autograd.py —
+Variable:369, Lambda:393, math ops:32-250; Scala mirror
+zoo/.../pipeline/api/autograd/math.scala).
+
+The reference routes every op through py4j to Scala autograd nodes; here an op
+is a jnp lambda recorded on the Variable DAG (keras/engine/graph.py), so a
+CustomLoss or Lambda layer compiles into the same single XLA program as the
+rest of the model."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .keras.engine.graph import Variable, has_variable
+
+__all__ = [
+    "Variable", "Parameter", "Lambda", "CustomLoss",
+    "abs", "sum", "mean", "clip", "square", "sqrt", "exp", "log", "pow",
+    "maximum", "minimum", "max", "min", "neg", "softsign", "softplus",
+    "mm", "dot", "l2_normalize", "batch_dot", "stack", "expand_dims",
+    "contiguous", "mul", "add", "sub", "div", "epsilon", "squeeze",
+]
+
+_py_abs, _py_sum, _py_pow, _py_max, _py_min = abs, sum, pow, max, min
+
+
+def _unary(fn: Callable, name: str):
+    def op(x, *args, **kwargs):
+        if isinstance(x, Variable):
+            return Variable(op=lambda a: fn(a, *args, **kwargs),
+                            parents=[x], name=name)
+        return fn(x, *args, **kwargs)
+    op.__name__ = name
+    return op
+
+
+def _binary(fn: Callable, name: str):
+    def op(x, y):
+        xv, yv = isinstance(x, Variable), isinstance(y, Variable)
+        if xv and yv:
+            return Variable(op=fn, parents=[x, y], name=name)
+        if xv:
+            return Variable(op=lambda a: fn(a, y), parents=[x], name=name)
+        if yv:
+            return Variable(op=lambda b: fn(x, b), parents=[y], name=name)
+        return fn(x, y)
+    op.__name__ = name
+    return op
+
+
+def epsilon() -> float:
+    return 1e-7
+
+
+abs = _unary(jnp.abs, "abs")
+square = _unary(jnp.square, "square")
+sqrt = _unary(jnp.sqrt, "sqrt")
+exp = _unary(jnp.exp, "exp")
+log = _unary(jnp.log, "log")
+neg = _unary(lambda a: -a, "neg")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+softplus = _unary(jax.nn.softplus, "softplus")
+contiguous = _unary(lambda a: a, "contiguous")
+
+
+def sum(x, axis: int = 0, keepdims: bool = False):
+    """reference autograd.sum (axis counts ALL dims incl. batch)."""
+    return _unary(lambda a: jnp.sum(a, axis=axis, keepdims=keepdims),
+                  "sum")(x)
+
+
+def mean(x, axis: int = 0, keepdims: bool = False):
+    return _unary(lambda a: jnp.mean(a, axis=axis, keepdims=keepdims),
+                  "mean")(x)
+
+
+def max(x, axis: int = 0, keepdims: bool = False):
+    return _unary(lambda a: jnp.max(a, axis=axis, keepdims=keepdims),
+                  "max")(x)
+
+
+def min(x, axis: int = 0, keepdims: bool = False):
+    return _unary(lambda a: jnp.min(a, axis=axis, keepdims=keepdims),
+                  "min")(x)
+
+
+def clip(x, min_value: float, max_value: float):
+    return _unary(lambda a: jnp.clip(a, min_value, max_value), "clip")(x)
+
+
+def pow(x, a: float):
+    return _unary(lambda v: v ** a, "pow")(x)
+
+
+def expand_dims(x, axis: int):
+    return _unary(lambda a: jnp.expand_dims(a, axis), "expand_dims")(x)
+
+
+def squeeze(x, axis: Optional[int] = None):
+    return _unary(lambda a: jnp.squeeze(a, axis=axis), "squeeze")(x)
+
+
+def l2_normalize(x, axis: int = -1):
+    return _unary(
+        lambda a: a / jnp.maximum(jnp.linalg.norm(a, axis=axis,
+                                                  keepdims=True), 1e-12),
+        "l2_normalize")(x)
+
+
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+add = _binary(lambda a, b: a + b, "add")
+sub = _binary(lambda a, b: a - b, "sub")
+mul = _binary(lambda a, b: a * b, "mul")
+div = _binary(lambda a, b: a / b, "div")
+
+
+def mm(x, y, axes: Optional[Sequence[int]] = None):
+    """Batch matrix multiply with optional contraction axes (reference
+    autograd.mm)."""
+    def fn(a, b):
+        if axes is not None:
+            return jnp.einsum(a, list(range(a.ndim)), b,
+                              list(range(a.ndim, a.ndim + b.ndim)),
+                              ) if False else jax.lax.dot_general(
+                a, b, (((axes[0],), (axes[1],)), ((0,), (0,))))
+        return jnp.matmul(a, b)
+    return _binary(fn, "mm")(x, y)
+
+
+def batch_dot(x, y, axes: Sequence[int] = (2, 2), normalize: bool = False):
+    def fn(a, b):
+        aa, bb = a, b
+        if normalize:
+            aa = aa / jnp.maximum(
+                jnp.linalg.norm(aa, axis=axes[0], keepdims=True), 1e-12)
+            bb = bb / jnp.maximum(
+                jnp.linalg.norm(bb, axis=axes[1], keepdims=True), 1e-12)
+        return jax.lax.dot_general(
+            aa, bb, (((axes[0],), (axes[1],)), ((0,), (0,))))
+    return _binary(fn, "batch_dot")(x, y)
+
+
+def dot(x, y):
+    return mm(x, y)
+
+
+def stack(inputs: Sequence[Any], axis: int = 1):
+    if has_variable(inputs):
+        return Variable(op=lambda *xs: jnp.stack(xs, axis=axis),
+                        parents=list(inputs), name="stack")
+    return jnp.stack(inputs, axis=axis)
+
+
+class Parameter(Variable):
+    """A trainable standalone weight usable in autograd expressions
+    (reference autograd.py Parameter). Realised as a flax param when the
+    graph executes inside a Model."""
+
+    def __init__(self, shape, init_weight=None, trainable: bool = True,
+                 name: Optional[str] = None):
+        import flax.linen as nn
+
+        pshape = tuple(shape)
+        weight = init_weight
+
+        class _ParamLeaf(nn.Module):
+            @nn.compact
+            def __call__(self):
+                if weight is not None:
+                    init = lambda rng: jnp.asarray(weight)
+                else:
+                    init = lambda rng: nn.initializers.lecun_normal()(
+                        rng, pshape)
+                p = self.param("weight", lambda rng: init(rng))
+                return p if trainable else jax.lax.stop_gradient(p)
+
+        super().__init__(shape=pshape, name=name or "parameter",
+                         op=_ParamLeaf(), parents=[])
+
+
+class Lambda:
+    """Wrap a jnp function as a layer / graph node (reference autograd.py
+    Lambda:393). Call on Variables for graph mode or arrays for eager."""
+
+    def __init__(self, function: Callable, input_shape=None, name=None):
+        self.function = function
+        self.name = name or "lambda"
+
+    def __call__(self, *xs):
+        if has_variable(xs):
+            return Variable(op=self.function, parents=list(xs),
+                            name=self.name)
+        return self.function(*xs)
+
+
+class CustomLoss:
+    """Build a loss from a symbolic expression over (y_true, y_pred) or keep
+    a python function (reference autograd.py CustomLoss / topology losses).
+    The estimator accepts it anywhere a loss is accepted."""
+
+    def __init__(self, loss_func: Callable = None, y_pred_shape=None,
+                 y_true_shape=None):
+        self.loss_func = loss_func
+
+    def __call__(self, y_true, y_pred):
+        out = self.loss_func(y_true, y_pred)
+        if isinstance(out, Variable):
+            raise TypeError("CustomLoss function must operate on arrays "
+                            "(it is traced under jit); got a Variable graph")
+        return out

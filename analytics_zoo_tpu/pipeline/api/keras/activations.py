@@ -1,0 +1,49 @@
+"""Activation registry for the Keras-style API (reference string set:
+pyzoo/zoo/pipeline/api/keras/layers/core.py Activation docstring)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x):
+    return x
+
+
+def hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+_ACTIVATIONS = {
+    "linear": linear,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": hard_sigmoid,
+    "tanh": jnp.tanh,
+    "softmax": jax.nn.softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "log_softmax": jax.nn.log_softmax,
+    "exp": jnp.exp,
+}
+
+
+def get(activation: Optional[Union[str, Callable]]) -> Callable:
+    if activation is None:
+        return linear
+    if callable(activation):
+        return activation
+    try:
+        return _ACTIVATIONS[activation.lower()]
+    except KeyError:
+        raise ValueError(f"unknown activation {activation!r}; "
+                         f"available: {sorted(_ACTIVATIONS)}")

@@ -1,0 +1,224 @@
+"""Symbolic graph machinery behind the functional Keras API and autograd.
+
+The reference builds its functional graphs JVM-side: python Variables proxy
+Scala nodes via py4j (reference: pyzoo/zoo/pipeline/api/autograd.py:369
+``Variable``, pyzoo/zoo/pipeline/api/keras/engine/topology.py:31). Here a
+Variable is a lightweight DAG node evaluated inside ONE flax module — so the
+whole functional model jits to a single XLA program; there is no graph
+serialization boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+_uid_counter = itertools.count()
+
+
+class Variable:
+    """A symbolic tensor: placeholder (op=None) or the output of applying a
+    layer / pure function to parent Variables."""
+
+    def __init__(self, shape: Optional[Tuple] = None, name: Optional[str] = None,
+                 op: Any = None, parents: Sequence["Variable"] = (),
+                 op_kwargs: Optional[dict] = None):
+        self._uid = next(_uid_counter)
+        self.shape = tuple(shape) if shape is not None else None
+        self.name = name or f"var_{self._uid}"
+        self.op = op                      # None | nn.Module | callable
+        self.parents = list(parents)
+        self.op_kwargs = op_kwargs or {}
+
+    # --- autograd operator sugar (reference: autograd.py:32-250) ------------
+    def _binop(self, other, fn, name):
+        if isinstance(other, Variable):
+            return Variable(op=fn, parents=[self, other], name=name)
+        return Variable(op=lambda a, _o=other: fn(a, _o), parents=[self],
+                        name=name)
+
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b, "add")
+
+    def __radd__(self, other):
+        return self._binop(other, lambda a, b: b + a, "radd")
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b, "sub")
+
+    def __rsub__(self, other):
+        return self._binop(other, lambda a, b: b - a, "rsub")
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b, "mul")
+
+    def __rmul__(self, other):
+        return self._binop(other, lambda a, b: b * a, "rmul")
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b, "div")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, lambda a, b: b / a, "rdiv")
+
+    def __neg__(self):
+        return Variable(op=lambda a: -a, parents=[self], name="neg")
+
+    def __pow__(self, p):
+        return Variable(op=lambda a: a ** p, parents=[self], name="pow")
+
+    def __getitem__(self, idx):
+        return Variable(op=lambda a: a[idx], parents=[self], name="slice")
+
+    def index_select(self, dim: int, index: int):
+        """reference: autograd.py Variable.index_select"""
+        return Variable(op=lambda a: jnp.take(a, index, axis=dim),
+                        parents=[self], name="index_select")
+
+    def slice(self, dim: int, start_index: int, length: int):
+        return Variable(
+            op=lambda a: jnp.take(a, jnp.arange(start_index,
+                                                start_index + length),
+                                  axis=dim),
+            parents=[self], name="slice_range")
+
+
+def has_variable(args) -> bool:
+    return any(isinstance(a, Variable) for a in args)
+
+
+def symbolic_apply(module, *args, **kwargs) -> Variable:
+    """Record `module(*args)` as a graph node (all args must be Variables)."""
+    parents = [a for a in args if isinstance(a, Variable)]
+    if len(parents) != len(args):
+        raise TypeError("mixing Variables and arrays in one call is not "
+                        "supported; wrap constants with autograd ops instead")
+    return Variable(op=module, parents=parents,
+                    name=getattr(module, "name", None) or
+                    type(module).__name__.lower(), op_kwargs=kwargs)
+
+
+def keras_call(fn: Callable) -> Callable:
+    """Decorator for layer ``__call__``: route Variable inputs to the symbolic
+    graph, arrays to the real computation. Preserves flax's compact marker.
+
+    flax wraps every module method at class-creation time and raises
+    CallCompactUnboundModuleError before the wrapped function runs, so the
+    real interception happens in ``_install_symbolic_dispatch`` below; this
+    decorator stays as a second line of defence for non-flax callables."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if has_variable(args):
+            return symbolic_apply(self, *args, **kwargs)
+        return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
+def _install_symbolic_dispatch():
+    """Teach every flax module to record itself as a graph node when called
+    on symbolic Variables (unbound call with Variable args). This is what
+    makes ``Dense(8)(Input(shape=(4,)))`` build a DAG — for our layers AND
+    any stock flax module a user drops into the functional API."""
+    import flax.linen as nn
+
+    if getattr(nn.Module, "_zoo_symbolic_dispatch", False):
+        return
+    orig = nn.Module._call_wrapped_method
+
+    def patched(self, fun, args, kwargs):
+        if has_variable(args):
+            return symbolic_apply(self, *args, **kwargs)
+        return orig(self, fun, args, kwargs)
+
+    nn.Module._call_wrapped_method = patched
+    nn.Module._zoo_symbolic_dispatch = True
+
+
+_install_symbolic_dispatch()
+
+
+def call_layer(layer, *xs, train: bool = False):
+    """Invoke a child layer, forwarding the train flag only if it takes one."""
+    import inspect
+    try:
+        sig = inspect.signature(type(layer).__call__)
+        params = sig.parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "train" in params:
+        return layer(*xs, train=train)
+    if "deterministic" in params:
+        return layer(*xs, deterministic=not train)
+    if "training" in params:
+        return layer(*xs, training=train)
+    return layer(*xs)
+
+
+def topo_order(outputs: Sequence[Variable]) -> List[Variable]:
+    order: List[Variable] = []
+    seen: Dict[int, bool] = {}
+
+    def visit(v: Variable):
+        if v._uid in seen:
+            return
+        seen[v._uid] = True
+        for p in v.parents:
+            visit(p)
+        order.append(v)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+def graph_modules(outputs: Sequence[Variable]):
+    """Collect the unique layer modules reachable from `outputs` (dedup by
+    identity so a shared instance shares weights) plus the uid→slot map.
+    The functional Model stores these as flax fields so the layers become
+    bound children of the graph module."""
+    import flax.linen as nn
+
+    modules: List[Any] = []
+    slots: List[Tuple[int, int]] = []
+    seen: Dict[int, int] = {}
+    for v in topo_order(outputs):
+        if isinstance(v.op, nn.Module):
+            key = id(v.op)
+            if key not in seen:
+                seen[key] = len(modules)
+                modules.append(v.op)
+            slots.append((v._uid, seen[key]))
+    return tuple(modules), tuple(slots)
+
+
+def evaluate_graph(inputs: Sequence[Variable], outputs: Sequence[Variable],
+                   xs: Sequence[Any], train: bool = False,
+                   bound: Optional[Dict[int, Any]] = None):
+    """Evaluate the DAG. `bound` maps node uid -> the parent-bound flax module
+    to call for that node (unbound instances can't execute under linen)."""
+    import flax.linen as nn
+
+    bound = bound or {}
+    cache: Dict[int, Any] = {}
+    for var, x in zip(inputs, xs):
+        cache[var._uid] = x
+    for v in topo_order(outputs):
+        if v._uid in cache:
+            continue
+        if v.op is None:
+            raise ValueError(
+                f"placeholder {v.name} is not among the model inputs")
+        parent_vals = [cache[p._uid] for p in v.parents]
+        if isinstance(v.op, nn.Module):
+            layer = bound.get(v._uid, v.op)
+            cache[v._uid] = call_layer(layer, *parent_vals, train=train,
+                                       **v.op_kwargs)
+        else:
+            cache[v._uid] = v.op(*parent_vals, **v.op_kwargs)
+    outs = tuple(cache[o._uid] for o in outputs)
+    return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,180 @@
+"""Sequential / functional Model fronts for the Keras-style API.
+
+Parity: pyzoo/zoo/pipeline/api/keras/engine/topology.py:31-342 (KerasNet with
+compile/fit/evaluate/predict, Sequential, Model over py4j). Here a model IS a
+flax module — Sequential chains layers, Model evaluates the symbolic DAG from
+engine/graph.py — and compile/fit route to the single TPU TrainEngine
+(orca/learn/engine.py), so `Sequential().add(...).fit(x, y)` runs one jitted
+XLA step over the mesh instead of the reference's py4j → DistriOptimizer hop
+(SURVEY.md §3.2)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import numpy as np
+
+from .graph import Variable, call_layer, evaluate_graph, graph_modules, \
+    has_variable, symbolic_apply, keras_call
+
+
+def Input(shape: Tuple[int, ...] = (), name: Optional[str] = None) -> Variable:
+    """Symbolic placeholder; `shape` excludes the batch dim (reference
+    topology.py Input)."""
+    return Variable(shape=(None,) + tuple(shape), name=name or "input")
+
+
+class _SequentialModule(nn.Module):
+    layers: Tuple[nn.Module, ...] = ()
+
+    @nn.compact
+    def __call__(self, *xs, train: bool = False):
+        if has_variable(xs):
+            return symbolic_apply(self, *xs)
+        x = xs[0] if len(xs) == 1 else xs
+        for lyr in self.layers:
+            if isinstance(x, tuple) and not isinstance(lyr, nn.Module):
+                x = lyr(*x)
+            else:
+                x = call_layer(lyr, x, train=train) \
+                    if not isinstance(x, tuple) else \
+                    call_layer(lyr, *x, train=train)
+        return x
+
+
+class _GraphModule(nn.Module):
+    inputs: Tuple[Variable, ...] = ()
+    outputs: Tuple[Variable, ...] = ()
+    layers: Tuple[nn.Module, ...] = ()       # adopted as children by flax
+    layer_slots: Tuple[Tuple[int, int], ...] = ()  # (node uid, layer index)
+
+    @nn.compact
+    def __call__(self, *xs, train: bool = False):
+        if has_variable(xs):
+            return symbolic_apply(self, *xs)
+        bound = {uid: self.layers[i] for uid, i in self.layer_slots}
+        return evaluate_graph(self.inputs, self.outputs, xs, train=train,
+                              bound=bound)
+
+
+class KerasNet:
+    """compile/fit/evaluate/predict surface shared by Sequential and Model.
+
+    Mirrors reference topology.py KerasNet: compile(optimizer, loss, metrics)
+    :116, fit(x, y, batch_size, nb_epoch, validation_data) :222,
+    evaluate :280, predict :302 — with the estimator underneath."""
+
+    def __init__(self):
+        self._estimator = None
+        self._compile_args: Dict[str, Any] = {}
+        self._tb_dir: Optional[str] = None
+
+    # -- module construction (implemented by subclasses) ---------------------
+    def to_module(self) -> nn.Module:
+        raise NotImplementedError
+
+    # -- training surface ----------------------------------------------------
+    def compile(self, optimizer="adam", loss="mean_squared_error",
+                metrics: Optional[List] = None):
+        self._compile_args = dict(optimizer=optimizer, loss=loss,
+                                  metrics=metrics)
+        self._estimator = None  # rebuilt lazily with the module
+        return self
+
+    @property
+    def estimator(self):
+        if self._estimator is None:
+            from .....orca.learn.estimator import TPUEstimator
+            args = self._compile_args or dict(optimizer="adam",
+                                              loss="mean_squared_error",
+                                              metrics=None)
+            self._estimator = TPUEstimator(
+                self.to_module(), loss=args["loss"],
+                optimizer=args["optimizer"], metrics=args["metrics"],
+                model_dir=self._tb_dir)
+        return self._estimator
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        import os
+        self._tb_dir = os.path.join(log_dir, app_name)
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, distributed: bool = True, **kwargs):
+        data = {"x": x, "y": y} if y is not None else x
+        if validation_data is not None and isinstance(validation_data, tuple):
+            validation_data = {"x": validation_data[0],
+                               "y": validation_data[1]}
+        return self.estimator.fit(data, epochs=nb_epoch,
+                                  batch_size=batch_size,
+                                  validation_data=validation_data, **kwargs)
+
+    def evaluate(self, x, y=None, batch_size: int = 32, **kwargs):
+        data = {"x": x, "y": y} if y is not None else x
+        return self.estimator.evaluate(data, batch_size=batch_size, **kwargs)
+
+    def predict(self, x, batch_size: int = 32, distributed: bool = False,
+                **kwargs):
+        data = {"x": x} if not isinstance(x, dict) else x
+        return self.estimator.predict(data, batch_size=batch_size, **kwargs)
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.estimator.engine.params)
+
+    def save_weights(self, path: str):
+        self.estimator.save(path)
+
+    def load_weights(self, path: str):
+        self.estimator.load(path)
+
+    def summary(self) -> str:
+        mod = self.to_module()
+        lines = [repr(mod)]
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+class Sequential(KerasNet):
+    """reference topology.py Sequential (py4j createZooKerasSequential)."""
+
+    def __init__(self, layers: Optional[Sequence[nn.Module]] = None):
+        super().__init__()
+        self._layers: List[nn.Module] = list(layers or [])
+
+    def add(self, layer) -> "Sequential":
+        if isinstance(layer, KerasNet):
+            layer = layer.to_module()
+        self._layers.append(layer)
+        self._estimator = None
+        return self
+
+    def to_module(self) -> nn.Module:
+        return _SequentialModule(layers=tuple(self._layers))
+
+    def __call__(self, x):
+        """Symbolic or eager application of the whole stack."""
+        return self.to_module()(x)
+
+
+class Model(KerasNet):
+    """Functional graph model (reference topology.py Model(input, output))."""
+
+    def __init__(self, input, output):
+        super().__init__()
+        ins = input if isinstance(input, (list, tuple)) else [input]
+        outs = output if isinstance(output, (list, tuple)) else [output]
+        if not all(isinstance(v, Variable) for v in ins + outs):
+            raise TypeError("Model(input, output) takes symbolic Variables "
+                            "from Input(...)")
+        self.inputs = tuple(ins)
+        self.outputs = tuple(outs)
+
+    def to_module(self) -> nn.Module:
+        modules, slots = graph_modules(self.outputs)
+        return _GraphModule(inputs=self.inputs, outputs=self.outputs,
+                            layers=modules, layer_slots=slots)
+
+    def __call__(self, *xs):
+        return self.to_module()(*xs)

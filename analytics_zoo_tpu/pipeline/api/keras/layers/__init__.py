@@ -1,0 +1,38 @@
+from .core import (Activation, AddConstant, BinaryThreshold, CAdd, CMul,
+                   Dense, Dropout, Exp, ExpandDim, Flatten, GaussianSampler,
+                   GetShape, HardShrink, HardTanh, Highway, Identity, Log,
+                   Masking, MaxoutDense, Merge, Mul, MulConstant, Narrow,
+                   Negative, Permute, Power, RepeatVector, Reshape,
+                   ResizeBilinear, Scale, Select, SoftShrink, SparseDense,
+                   Sqrt, Square, Squeeze, Threshold, merge)
+from .convolutional import (AtrousConvolution1D, AtrousConvolution2D,
+                            Convolution1D, Convolution2D, Convolution3D,
+                            Cropping1D, Cropping2D, Cropping3D,
+                            Deconvolution2D, LocallyConnected1D,
+                            LocallyConnected2D, SeparableConvolution2D,
+                            ShareConvolution2D, UpSampling1D, UpSampling2D,
+                            UpSampling3D, ZeroPadding1D, ZeroPadding2D,
+                            ZeroPadding3D)
+from .pooling import (AveragePooling1D, AveragePooling2D, AveragePooling3D,
+                      GlobalAveragePooling1D, GlobalAveragePooling2D,
+                      GlobalAveragePooling3D, GlobalMaxPooling1D,
+                      GlobalMaxPooling2D, GlobalMaxPooling3D, MaxPooling1D,
+                      MaxPooling2D, MaxPooling3D)
+from .normalization import (BatchNormalization, LayerNormalization, LRN2D,
+                            WithinChannelLRN2D)
+from .recurrent import (Bidirectional, ConvLSTM2D, GRU, LSTM, SimpleRNN,
+                        TimeDistributed)
+from .embeddings import Embedding, SparseEmbedding, WordEmbedding
+from .noise import (GaussianDropout, GaussianNoise, SpatialDropout1D,
+                    SpatialDropout2D, SpatialDropout3D)
+from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, SReLU,
+                                   ThresholdedReLU)
+from .self_attention import (BERT, MultiHeadAttention, TransformerBlock,
+                             TransformerLayer)
+
+# Keras 2-style aliases (reference keras2 package)
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+Conv3D = Convolution3D
+Conv2DTranspose = Deconvolution2D
+SeparableConv2D = SeparableConvolution2D

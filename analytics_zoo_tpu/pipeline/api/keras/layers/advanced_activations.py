@@ -1,0 +1,95 @@
+"""Advanced activation layers (parity:
+pyzoo/zoo/pipeline/api/keras/layers/advanced_activations.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..engine.graph import keras_call
+
+
+class LeakyReLU(nn.Module):
+    alpha: float = 0.3
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jax.nn.leaky_relu(x, negative_slope=self.alpha)
+
+
+class ELU(nn.Module):
+    alpha: float = 1.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jax.nn.elu(x, alpha=self.alpha)
+
+
+class PReLU(nn.Module):
+    """Learned per-channel slope (reference PReLU; nOutputPlane=0 -> shared)."""
+    n_output_plane: int = 0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        shape = (1,) if self.n_output_plane == 0 else (self.n_output_plane,)
+        alpha = self.param("alpha",
+                           nn.initializers.constant(0.25), shape)
+        if self.n_output_plane != 0:
+            bshape = [1] * x.ndim
+            bshape[1] = self.n_output_plane    # channel axis 1 (th)
+            alpha = alpha.reshape(bshape)
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+class ThresholdedReLU(nn.Module):
+    theta: float = 1.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.where(x > self.theta, x, 0.0)
+
+
+class SReLU(nn.Module):
+    """S-shaped ReLU with four learned per-feature params (reference SReLU)."""
+    input_shape: Any = None
+    shared_axes: Optional[Tuple[int, ...]] = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        feat = x.shape[-1]
+        t_r = self.param("t_right", nn.initializers.ones, (feat,))
+        a_r = self.param("a_right", nn.initializers.constant(0.2), (feat,))
+        t_l = self.param("t_left", nn.initializers.zeros, (feat,))
+        a_l = self.param("a_left", nn.initializers.constant(0.2), (feat,))
+        above = jnp.where(x >= t_r, t_r + a_r * (x - t_r), x)
+        return jnp.where(x <= t_l, t_l + a_l * (x - t_l), above)
+
+
+class RReLU(nn.Module):
+    """Randomized leaky ReLU: random slope in [lower, upper] at train time,
+    mean slope at eval (reference advanced_activations.py RReLU)."""
+    lower: float = 1.0 / 8
+    upper: float = 1.0 / 3
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if train:
+            a = jax.random.uniform(self.make_rng("dropout"), x.shape,
+                                   minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)

@@ -1,0 +1,400 @@
+"""Convolution/padding/cropping/upsampling layers.
+
+Parity: pyzoo/zoo/pipeline/api/keras/layers/convolutional.py. TPU-first
+deviation: internal layout is channels-last (NHWC) so XLA tiles convs onto the
+MXU directly; ``dim_ordering="th"`` inputs are transposed at the layer edge
+rather than propagating NCHW through the compute graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import activations
+from ..engine.graph import keras_call
+
+
+def _maybe_nchw_in(x, dim_ordering, spatial):
+    if dim_ordering == "th":
+        return jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def _maybe_nchw_out(x, dim_ordering):
+    if dim_ordering == "th":
+        return jnp.moveaxis(x, -1, 1)
+    return x
+
+
+def _pad_mode(border_mode: str) -> str:
+    return {"same": "SAME", "valid": "VALID"}[border_mode]
+
+
+class Convolution1D(nn.Module):
+    """reference convolutional.py Convolution1D (input (batch, steps, dim))."""
+    nb_filter: int = 1
+    filter_length: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    border_mode: str = "valid"
+    subsample_length: int = 1
+    dilation_rate: int = 1
+    use_bias: bool = True
+    init_method: str = "glorot_uniform"
+    W_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.nb_filter, (self.filter_length,),
+                    strides=(self.subsample_length,),
+                    kernel_dilation=(self.dilation_rate,),
+                    padding=_pad_mode(self.border_mode),
+                    use_bias=self.use_bias)(x)
+        return activations.get(self.activation)(y)
+
+
+class AtrousConvolution1D(Convolution1D):
+    """reference convolutional.py AtrousConvolution1D (dilated conv)."""
+    atrous_rate: int = 1
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.nb_filter, (self.filter_length,),
+                    strides=(self.subsample_length,),
+                    kernel_dilation=(self.atrous_rate,),
+                    padding=_pad_mode(self.border_mode),
+                    use_bias=self.use_bias)(x)
+        return activations.get(self.activation)(y)
+
+
+class Convolution2D(nn.Module):
+    """reference convolutional.py Convolution2D."""
+    nb_filter: int = 1
+    nb_row: int = 3
+    nb_col: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int] = (1, 1)
+    dim_ordering: str = "th"
+    use_bias: bool = True
+    init_method: str = "glorot_uniform"
+    W_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        y = nn.Conv(self.nb_filter, (self.nb_row, self.nb_col),
+                    strides=tuple(self.subsample),
+                    padding=_pad_mode(self.border_mode),
+                    use_bias=self.use_bias)(x)
+        y = activations.get(self.activation)(y)
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class AtrousConvolution2D(Convolution2D):
+    atrous_rate: Tuple[int, int] = (1, 1)
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        y = nn.Conv(self.nb_filter, (self.nb_row, self.nb_col),
+                    strides=tuple(self.subsample),
+                    kernel_dilation=tuple(self.atrous_rate),
+                    padding=_pad_mode(self.border_mode),
+                    use_bias=self.use_bias)(x)
+        y = activations.get(self.activation)(y)
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class ShareConvolution2D(Convolution2D):
+    """Scala ShareConvolution shares workspace memory between replicas; XLA
+    owns buffers, so this is Convolution2D with the same signature."""
+
+
+class Convolution3D(nn.Module):
+    nb_filter: int = 1
+    kernel_dim1: int = 3
+    kernel_dim2: int = 3
+    kernel_dim3: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int, int] = (1, 1, 1)
+    dim_ordering: str = "th"
+    use_bias: bool = True
+    init_method: str = "glorot_uniform"
+    W_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 3)
+        y = nn.Conv(self.nb_filter,
+                    (self.kernel_dim1, self.kernel_dim2, self.kernel_dim3),
+                    strides=tuple(self.subsample),
+                    padding=_pad_mode(self.border_mode),
+                    use_bias=self.use_bias)(x)
+        y = activations.get(self.activation)(y)
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class Deconvolution2D(nn.Module):
+    """Transposed conv (reference convolutional.py Deconvolution2D)."""
+    nb_filter: int = 1
+    nb_row: int = 3
+    nb_col: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int] = (1, 1)
+    dim_ordering: str = "th"
+    use_bias: bool = True
+    init_method: str = "glorot_uniform"
+    output_shape: Any = None
+    W_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        y = nn.ConvTranspose(self.nb_filter, (self.nb_row, self.nb_col),
+                             strides=tuple(self.subsample),
+                             padding=_pad_mode(self.border_mode),
+                             use_bias=self.use_bias)(x)
+        y = activations.get(self.activation)(y)
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class SeparableConvolution2D(nn.Module):
+    """Depthwise + pointwise conv (reference SeparableConvolution2D)."""
+    nb_filter: int = 1
+    nb_row: int = 3
+    nb_col: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int] = (1, 1)
+    depth_multiplier: int = 1
+    dim_ordering: str = "th"
+    use_bias: bool = True
+    init_method: str = "glorot_uniform"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        in_ch = x.shape[-1]
+        depth = nn.Conv(in_ch * self.depth_multiplier,
+                        (self.nb_row, self.nb_col),
+                        strides=tuple(self.subsample),
+                        padding=_pad_mode(self.border_mode),
+                        feature_group_count=in_ch,
+                        use_bias=False)(x)
+        y = nn.Conv(self.nb_filter, (1, 1), use_bias=self.use_bias)(depth)
+        y = activations.get(self.activation)(y)
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class LocallyConnected1D(nn.Module):
+    """Unshared-weights conv1d (reference local.py LocallyConnected1D)."""
+    nb_filter: int = 1
+    filter_length: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    subsample_length: int = 1
+    use_bias: bool = True
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        b, steps, dim = x.shape
+        out_len = (steps - self.filter_length) // self.subsample_length + 1
+        # unfold into per-position patches, per-position weights
+        idx = (jnp.arange(out_len)[:, None] * self.subsample_length +
+               jnp.arange(self.filter_length)[None, :])
+        patches = x[:, idx, :].reshape(b, out_len,
+                                       self.filter_length * dim)
+        w = self.param("kernel", nn.initializers.glorot_uniform(),
+                       (out_len, self.filter_length * dim, self.nb_filter))
+        y = jnp.einsum("bli,lio->blo", patches, w)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (out_len, self.nb_filter))
+            y = y + bias
+        return activations.get(self.activation)(y)
+
+
+class LocallyConnected2D(nn.Module):
+    """reference local.py LocallyConnected2D (channels-last internally)."""
+    nb_filter: int = 1
+    nb_row: int = 3
+    nb_col: int = 3
+    activation: Optional[Union[str, Callable]] = None
+    subsample: Tuple[int, int] = (1, 1)
+    dim_ordering: str = "th"
+    use_bias: bool = True
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        b, h, w, c = x.shape
+        sr, sc = self.subsample
+        oh = (h - self.nb_row) // sr + 1
+        ow = (w - self.nb_col) // sc + 1
+        ri = (jnp.arange(oh)[:, None] * sr + jnp.arange(self.nb_row)[None, :])
+        ci = (jnp.arange(ow)[:, None] * sc + jnp.arange(self.nb_col)[None, :])
+        patches = x[:, ri[:, None, :, None], ci[None, :, None, :], :]
+        patches = patches.reshape(b, oh, ow, self.nb_row * self.nb_col * c)
+        wgt = self.param("kernel", nn.initializers.glorot_uniform(),
+                         (oh, ow, self.nb_row * self.nb_col * c,
+                          self.nb_filter))
+        y = jnp.einsum("bhwi,hwio->bhwo", patches, wgt)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (oh, ow, self.nb_filter))
+            y = y + bias
+        y = activations.get(self.activation)(y)
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class Cropping1D(nn.Module):
+    cropping: Tuple[int, int] = (1, 1)
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b, :]
+
+
+class Cropping2D(nn.Module):
+    cropping: Tuple[Tuple[int, int], Tuple[int, int]] = ((0, 0), (0, 0))
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        (t, bm), (l, r) = self.cropping
+        if self.dim_ordering == "th":
+            return x[:, :, t:x.shape[2] - bm, l:x.shape[3] - r]
+        return x[:, t:x.shape[1] - bm, l:x.shape[2] - r, :]
+
+
+class Cropping3D(nn.Module):
+    cropping: Tuple = ((1, 1), (1, 1), (1, 1))
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        (a1, b1), (a2, b2), (a3, b3) = self.cropping
+        if self.dim_ordering == "th":
+            return x[:, :, a1:x.shape[2] - b1, a2:x.shape[3] - b2,
+                     a3:x.shape[4] - b3]
+        return x[:, a1:x.shape[1] - b1, a2:x.shape[2] - b2,
+                 a3:x.shape[3] - b3, :]
+
+
+def _zero_pad(x, pads, dim_ordering, spatial_ndim):
+    cfg = [(0, 0)] * x.ndim
+    start = 2 if dim_ordering == "th" else 1
+    for i, (a, b) in enumerate(pads):
+        cfg[start + i] = (a, b)
+    return jnp.pad(x, cfg)
+
+
+class ZeroPadding1D(nn.Module):
+    padding: Union[int, Tuple[int, int]] = 1
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        p = self.padding
+        p = (p, p) if isinstance(p, int) else tuple(p)
+        return jnp.pad(x, ((0, 0), p, (0, 0)))
+
+
+class ZeroPadding2D(nn.Module):
+    padding: Tuple = (1, 1)
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        p = self.padding
+        pads = ((p[0], p[0]), (p[1], p[1])) if len(p) == 2 else \
+            ((p[0], p[1]), (p[2], p[3]))
+        return _zero_pad(x, pads, self.dim_ordering, 2)
+
+
+class ZeroPadding3D(nn.Module):
+    padding: Tuple[int, int, int] = (1, 1, 1)
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        p = self.padding
+        pads = ((p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+        return _zero_pad(x, pads, self.dim_ordering, 3)
+
+
+def _upsample(x, factors, start_axis):
+    for i, f in enumerate(factors):
+        x = jnp.repeat(x, f, axis=start_axis + i)
+    return x
+
+
+class UpSampling1D(nn.Module):
+    length: int = 2
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return _upsample(x, (self.length,), 1)
+
+
+class UpSampling2D(nn.Module):
+    size: Tuple[int, int] = (2, 2)
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        start = 2 if self.dim_ordering == "th" else 1
+        return _upsample(x, tuple(self.size), start)
+
+
+class UpSampling3D(nn.Module):
+    size: Tuple[int, int, int] = (2, 2, 2)
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        start = 2 if self.dim_ordering == "th" else 1
+        return _upsample(x, tuple(self.size), start)

@@ -1,0 +1,486 @@
+"""Core Keras-style layers as flax modules.
+
+Parity targets: pyzoo/zoo/pipeline/api/keras/layers/core.py (Dense, Dropout,
+Activation, Flatten, Reshape, Permute, RepeatVector, Masking, Highway,
+MaxoutDense, math layers, …). Each layer is an ordinary flax ``nn.Module`` —
+the keras_call decorator additionally lets it participate in the symbolic
+functional graph (engine/graph.py), so ``layer(Input(...))`` builds a DAG
+while ``layer(array)`` computes. Weight layout/initialisers follow flax
+conventions, not BigDL's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import activations
+from ..engine.graph import keras_call
+
+Dtype = Any
+
+
+def _regularizer(_):
+    # L1/L2 regularisers are handled by optimizer weight-decay in this stack
+    # (optax.add_decayed_weights); layer args are accepted for API parity.
+    return None
+
+
+class Dense(nn.Module):
+    """reference: pyzoo/zoo/pipeline/api/keras/layers/core.py Dense"""
+    output_dim: int
+    activation: Optional[Union[str, Callable]] = None
+    use_bias: bool = True
+    init_method: str = "glorot_uniform"
+    W_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        kernel_init = (nn.initializers.glorot_uniform()
+                       if self.init_method == "glorot_uniform"
+                       else nn.initializers.lecun_normal())
+        y = nn.Dense(self.output_dim, use_bias=self.use_bias,
+                     kernel_init=kernel_init)(x)
+        return activations.get(self.activation)(y)
+
+
+class SparseDense(Dense):
+    """reference core.py SparseDense — dense math; XLA has no sparse matmul
+    on TPU, embeddings cover the sparse-input use case."""
+
+
+class Activation(nn.Module):
+    activation: Union[str, Callable] = "relu"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return activations.get(self.activation)(x)
+
+
+class Dropout(nn.Module):
+    """reference core.py Dropout (p = drop fraction)."""
+    p: float = 0.5
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dropout(rate=self.p, deterministic=not train)(x)
+
+
+class Flatten(nn.Module):
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+class Reshape(nn.Module):
+    """target_shape may contain one -1 (inferred), like the reference."""
+    target_shape: Tuple[int, ...] = ()
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return x.reshape((x.shape[0],) + tuple(self.target_shape))
+
+
+class Permute(nn.Module):
+    """dims are 1-indexed over non-batch axes, matching the reference."""
+    dims: Tuple[int, ...] = ()
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.transpose(x, (0,) + tuple(self.dims))
+
+
+class RepeatVector(nn.Module):
+    n: int = 1
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+
+class Masking(nn.Module):
+    """Zeroes timesteps equal to mask_value (downstream layers see zeros; the
+    engine's loss masking covers the metric side)."""
+    mask_value: float = 0.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep.astype(x.dtype)
+
+
+class Highway(nn.Module):
+    """reference core.py Highway: y = t * h(Wx) + (1-t) * x"""
+    activation: Optional[Union[str, Callable]] = None
+    use_bias: bool = True
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        h = activations.get(self.activation)(
+            nn.Dense(dim, use_bias=self.use_bias)(x))
+        t = jax.nn.sigmoid(nn.Dense(dim, use_bias=self.use_bias)(x))
+        return t * h + (1.0 - t) * x
+
+
+class MaxoutDense(nn.Module):
+    """reference core.py MaxoutDense: max over nb_feature linear maps."""
+    output_dim: int = 1
+    nb_feature: int = 4
+    use_bias: bool = True
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.output_dim * self.nb_feature,
+                     use_bias=self.use_bias)(x)
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2)
+
+
+class _Elementwise(nn.Module):
+    input_shape: Any = None
+
+    def fn(self, x):
+        raise NotImplementedError
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return self.fn(x)
+
+
+class Exp(_Elementwise):
+    def fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(_Elementwise):
+    def fn(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(_Elementwise):
+    def fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    def fn(self, x):
+        return jnp.square(x)
+
+
+class Negative(_Elementwise):
+    def fn(self, x):
+        return -x
+
+
+class Identity(_Elementwise):
+    def fn(self, x):
+        return x
+
+
+class AddConstant(nn.Module):
+    constant: float = 0.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return x + self.constant
+
+
+class MulConstant(nn.Module):
+    constant: float = 1.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return x * self.constant
+
+
+class Power(nn.Module):
+    """reference core.py Power: (shift + scale * x) ** power"""
+    power: float = 1.0
+    scale: float = 1.0
+    shift: float = 0.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Scale(nn.Module):
+    """Learned per-feature affine: x * w + b (reference core.py Scale)."""
+    axis: int = -1
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[self.axis]
+        shape = [1] * x.ndim
+        shape[self.axis] = dim
+        w = self.param("weight", nn.initializers.ones, tuple(shape))
+        b = self.param("bias", nn.initializers.zeros, tuple(shape))
+        return x * w + b
+
+
+class CAdd(nn.Module):
+    """Learned additive bias of arbitrary broadcast shape (reference CAdd)."""
+    size: Tuple[int, ...] = ()
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        b = self.param("bias", nn.initializers.zeros, tuple(self.size))
+        return x + b
+
+
+class CMul(nn.Module):
+    size: Tuple[int, ...] = ()
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, tuple(self.size))
+        return x * w
+
+
+class Mul(nn.Module):
+    """Single learned scalar multiplier (reference core.py Mul)."""
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (1,))
+        return x * w
+
+
+class Select(nn.Module):
+    """Select index `index` along dim `dim` (non-batch 1-indexed in the
+    reference; here dim counts all axes, negative allowed)."""
+    dim: int = 1
+    index: int = 0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.take(x, self.index, axis=self.dim)
+
+
+class Squeeze(nn.Module):
+    dim: Optional[Union[int, Tuple[int, ...]]] = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.squeeze(x, axis=self.dim)
+
+
+class ExpandDim(nn.Module):
+    dim: int = 0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.expand_dims(x, axis=self.dim)
+
+
+class Narrow(nn.Module):
+    """Slice `length` elements from `offset` along `dim` (reference Narrow)."""
+    dim: int = 1
+    offset: int = 0
+    length: int = 1
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + self.length,
+                                    axis=self.dim)
+
+
+class GetShape(nn.Module):
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.asarray(x.shape)
+
+
+class Threshold(nn.Module):
+    """x if x > th else v (reference core.py Threshold)."""
+    th: float = 1e-6
+    v: float = 0.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(nn.Module):
+    value: float = 1e-6
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return (x > self.value).astype(jnp.float32)
+
+
+class HardTanh(nn.Module):
+    min_value: float = -1.0
+    max_value: float = 1.0
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(nn.Module):
+    value: float = 0.5
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(nn.Module):
+    value: float = 0.5
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.value, 0.0)
+
+
+class GaussianSampler(nn.Module):
+    """VAE reparameterisation: input [mean, log_var] -> sample (reference
+    core.py GaussianSampler; takes a table of two tensors)."""
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, mean_logvar, train: bool = False):
+        mean, log_var = mean_logvar
+        if not train:
+            return mean
+        eps = jax.random.normal(self.make_rng("dropout"), mean.shape)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class Merge(nn.Module):
+    """Merge a list of inputs: mode in sum/mul/concat/ave/max/min/dot/cos
+    (reference engine/topology.py Merge)."""
+    mode: str = "sum"
+    concat_axis: int = -1
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, *xs):
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        m = self.mode
+        if m == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if m == "sum":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if m == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if m == "ave":
+            return sum(xs) / len(xs)
+        if m == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "dot":
+            a, b = xs
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if m == "cos":
+            a, b = xs
+            num = jnp.sum(a * b, axis=-1, keepdims=True)
+            den = (jnp.linalg.norm(a, axis=-1, keepdims=True) *
+                   jnp.linalg.norm(b, axis=-1, keepdims=True))
+            return num / jnp.maximum(den, 1e-8)
+        raise ValueError(f"unknown merge mode {m!r}")
+
+
+def merge(inputs: Sequence[Any], mode: str = "sum", concat_axis: int = -1,
+          name: Optional[str] = None):
+    """Functional merge over symbolic Variables or arrays."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(*inputs)
+
+
+class ResizeBilinear(nn.Module):
+    output_height: int = 0
+    output_width: int = 0
+    align_corners: bool = False
+    data_format: str = "channels_last"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        if self.data_format == "channels_first":
+            x = jnp.moveaxis(x, 1, -1)
+        out = jax.image.resize(
+            x, (x.shape[0], self.output_height, self.output_width, x.shape[3]),
+            method="bilinear")
+        if self.data_format == "channels_first":
+            out = jnp.moveaxis(out, -1, 1)
+        return out

@@ -1,0 +1,96 @@
+"""Embedding layers (parity: pyzoo/zoo/pipeline/api/keras/layers/embeddings.py
+Embedding/SparseEmbedding and WordEmbedding from the Scala layer set).
+
+TPU note: embedding lookup is a gather from an HBM-resident table; keep the
+table bfloat16 for bandwidth when large. Pretrained-weight loading takes a
+numpy array directly instead of the reference's GloVe-file JVM loader."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.graph import keras_call
+
+
+class Embedding(nn.Module):
+    input_dim: int = 1
+    output_dim: int = 1
+    init_method: str = "uniform"
+    weights: Any = None              # optional pretrained ndarray
+    trainable: bool = True
+    input_shape: Any = None
+    zero_based_id: bool = True
+    dtype: Any = jnp.float32
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        if self.weights is not None:
+            init = lambda rng, shape, dtype=self.dtype: jnp.asarray(
+                np.asarray(self.weights), dtype)
+        elif self.init_method == "uniform":
+            init = nn.initializers.uniform(scale=0.05)
+        else:
+            init = nn.initializers.normal(stddev=0.05)
+        table = self.param("embedding", init,
+                           (self.input_dim, self.output_dim), self.dtype)
+        idx = x.astype(jnp.int32)
+        if not self.zero_based_id:
+            idx = idx - 1
+        out = jnp.take(table, jnp.clip(idx, 0, self.input_dim - 1), axis=0)
+        if not self.trainable:
+            out = jax.lax.stop_gradient(out)
+        return out
+
+
+class SparseEmbedding(Embedding):
+    """reference embeddings.py SparseEmbedding — on TPU the lookup is the same
+    gather; sparsity of the input doesn't change the kernel."""
+
+
+class WordEmbedding(nn.Module):
+    """Frozen pretrained word embeddings (Scala keras/layers/WordEmbedding).
+    Construct via ``WordEmbedding.from_glove(path, word_index)`` or pass the
+    matrix directly."""
+    embedding_matrix: Any = None
+    trainable: bool = False
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        mat = np.asarray(self.embedding_matrix)
+        table = self.param(
+            "embedding",
+            lambda rng, shape: jnp.asarray(mat, jnp.float32), mat.shape)
+        out = jnp.take(table, x.astype(jnp.int32), axis=0)
+        return out if self.trainable else jax.lax.stop_gradient(out)
+
+    @staticmethod
+    def get_word_index(glove_path: str):
+        idx = {}
+        with open(glove_path, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                idx[line.split(" ", 1)[0]] = i + 1
+        return idx
+
+    @classmethod
+    def from_glove(cls, glove_path: str, word_index: Optional[dict] = None,
+                   trainable: bool = False):
+        vecs = {}
+        with open(glove_path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                vecs[parts[0]] = np.asarray(parts[1:], dtype=np.float32)
+        dim = len(next(iter(vecs.values())))
+        word_index = word_index or {w: i + 1 for i, w in enumerate(vecs)}
+        mat = np.zeros((max(word_index.values()) + 1, dim), np.float32)
+        for w, i in word_index.items():
+            if w in vecs:
+                mat[i] = vecs[w]
+        return cls(embedding_matrix=mat, trainable=trainable)

@@ -1,0 +1,88 @@
+"""Noise / stochastic-regularisation layers (parity:
+pyzoo/zoo/pipeline/api/keras/layers/noise.py + SpatialDropout from core)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..engine.graph import keras_call
+
+
+class GaussianNoise(nn.Module):
+    sigma: float = 0.1
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train:
+            return x
+        noise = jax.random.normal(self.make_rng("dropout"), x.shape, x.dtype)
+        return x + self.sigma * noise
+
+
+class GaussianDropout(nn.Module):
+    p: float = 0.5
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.p <= 0:
+            return x
+        stddev = (self.p / (1.0 - self.p)) ** 0.5
+        noise = jax.random.normal(self.make_rng("dropout"), x.shape, x.dtype)
+        return x * (1.0 + stddev * noise)
+
+
+def _spatial_dropout(x, rate, rng, broadcast_axes):
+    keep = 1.0 - rate
+    shape = [x.shape[i] if i not in broadcast_axes else 1
+             for i in range(x.ndim)]
+    mask = jax.random.bernoulli(rng, keep, tuple(shape)).astype(x.dtype)
+    return x * mask / keep
+
+
+class SpatialDropout1D(nn.Module):
+    """Drops whole feature maps: input (batch, steps, channels)."""
+    p: float = 0.5
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.p <= 0:
+            return x
+        return _spatial_dropout(x, self.p, self.make_rng("dropout"), (1,))
+
+
+class SpatialDropout2D(nn.Module):
+    p: float = 0.5
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.p <= 0:
+            return x
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return _spatial_dropout(x, self.p, self.make_rng("dropout"), axes)
+
+
+class SpatialDropout3D(nn.Module):
+    p: float = 0.5
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.p <= 0:
+            return x
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        return _spatial_dropout(x, self.p, self.make_rng("dropout"), axes)

@@ -1,0 +1,97 @@
+"""Normalization layers (parity: pyzoo/zoo/pipeline/api/keras/layers/
+normalization.py). BatchNormalization keeps running stats in flax's
+``batch_stats`` collection, which the TrainEngine threads as mutable extra
+vars; on a mesh, flax's use_running_average path plus the engine's psum of
+batch stats gives cross-replica behavior."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..engine.graph import keras_call
+
+
+class BatchNormalization(nn.Module):
+    epsilon: float = 1e-3
+    momentum: float = 0.99
+    beta_init: str = "zero"
+    gamma_init: str = "one"
+    dim_ordering: str = "th"
+    axis: Optional[int] = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # reference default: channel axis 1 for th ordering on 4D inputs.
+        if self.axis is not None:
+            axis = self.axis
+        elif self.dim_ordering == "th" and x.ndim == 4:
+            axis = 1
+        else:
+            axis = -1
+        return nn.BatchNorm(use_running_average=not train,
+                            momentum=self.momentum, epsilon=self.epsilon,
+                            axis=axis)(x)
+
+
+class LayerNormalization(nn.Module):
+    """Used by Transformer/BERT blocks (Scala: keras/layers/InternalLayerNorm)."""
+    epsilon: float = 1e-6
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(epsilon=self.epsilon)(x)
+
+
+class LRN2D(nn.Module):
+    """Local response normalization across channels (reference LRN2D)."""
+    alpha: float = 1e-4
+    k: float = 1.0
+    beta: float = 0.75
+    n: int = 5
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        ch_axis = 1 if self.dim_ordering == "th" else -1
+        xc = jnp.moveaxis(x, ch_axis, -1)
+        sq = jnp.square(xc)
+        half = self.n // 2
+        pads = [(0, 0)] * (xc.ndim - 1) + [(half, half)]
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(xc)
+        for i in range(self.n):
+            acc = acc + lax.slice_in_dim(padded, i, i + xc.shape[-1],
+                                         axis=xc.ndim - 1)
+        out = xc / jnp.power(self.k + self.alpha * acc, self.beta)
+        return jnp.moveaxis(out, -1, ch_axis)
+
+
+class WithinChannelLRN2D(nn.Module):
+    """Spatial (within-channel) LRN (reference WithinChannelLRN2D)."""
+    size: int = 5
+    alpha: float = 1.0
+    beta: float = 0.75
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        # channels-first spatial smoothing over size×size windows
+        sq = jnp.square(x)
+        win = self.size
+        avg = nn.avg_pool(jnp.moveaxis(sq, 1, -1), (win, win),
+                          strides=(1, 1), padding="SAME")
+        avg = jnp.moveaxis(avg, -1, 1)
+        return x / jnp.power(1.0 + (self.alpha / (win * win)) * avg,
+                             self.beta)

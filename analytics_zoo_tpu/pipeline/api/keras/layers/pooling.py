@@ -1,0 +1,162 @@
+"""Pooling layers (parity: pyzoo/zoo/pipeline/api/keras/layers/pooling.py).
+Channels-last internally; ``dim_ordering="th"`` transposed at the edges."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..engine.graph import keras_call
+from .convolutional import _maybe_nchw_in, _maybe_nchw_out, _pad_mode
+
+
+class _Pool(nn.Module):
+    pool_fn: str = "max"          # "max" | "avg"
+    window: Tuple[int, ...] = (2,)
+    strides: Optional[Tuple[int, ...]] = None
+    border_mode: str = "valid"
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    def _run(self, x):
+        strides = tuple(self.strides or self.window)
+        fn = nn.max_pool if self.pool_fn == "max" else nn.avg_pool
+        return fn(x, tuple(self.window), strides=strides,
+                  padding=_pad_mode(self.border_mode))
+
+
+class MaxPooling1D(_Pool):
+    pool_length: int = 2
+    stride: Optional[int] = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return nn.max_pool(x, (self.pool_length,),
+                           strides=(self.stride or self.pool_length,),
+                           padding=_pad_mode(self.border_mode))
+
+
+class AveragePooling1D(_Pool):
+    pool_length: int = 2
+    stride: Optional[int] = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        return nn.avg_pool(x, (self.pool_length,),
+                           strides=(self.stride or self.pool_length,),
+                           padding=_pad_mode(self.border_mode))
+
+
+class MaxPooling2D(nn.Module):
+    pool_size: Tuple[int, int] = (2, 2)
+    strides: Optional[Tuple[int, int]] = None
+    border_mode: str = "valid"
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        y = nn.max_pool(x, tuple(self.pool_size),
+                        strides=tuple(self.strides or self.pool_size),
+                        padding=_pad_mode(self.border_mode))
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class AveragePooling2D(nn.Module):
+    pool_size: Tuple[int, int] = (2, 2)
+    strides: Optional[Tuple[int, int]] = None
+    border_mode: str = "valid"
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 2)
+        y = nn.avg_pool(x, tuple(self.pool_size),
+                        strides=tuple(self.strides or self.pool_size),
+                        padding=_pad_mode(self.border_mode))
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class MaxPooling3D(nn.Module):
+    pool_size: Tuple[int, int, int] = (2, 2, 2)
+    strides: Optional[Tuple[int, int, int]] = None
+    border_mode: str = "valid"
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 3)
+        y = nn.max_pool(x, tuple(self.pool_size),
+                        strides=tuple(self.strides or self.pool_size),
+                        padding=_pad_mode(self.border_mode))
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class AveragePooling3D(nn.Module):
+    pool_size: Tuple[int, int, int] = (2, 2, 2)
+    strides: Optional[Tuple[int, int, int]] = None
+    border_mode: str = "valid"
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        x = _maybe_nchw_in(x, self.dim_ordering, 3)
+        y = nn.avg_pool(x, tuple(self.pool_size),
+                        strides=tuple(self.strides or self.pool_size),
+                        padding=_pad_mode(self.border_mode))
+        return _maybe_nchw_out(y, self.dim_ordering)
+
+
+class _GlobalPool(nn.Module):
+    dim_ordering: str = "th"
+    input_shape: Any = None
+    _reduce: str = "max"
+    _spatial: int = 2
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        if self.dim_ordering == "th" and x.ndim > 3:
+            axes = tuple(range(2, x.ndim))
+        elif x.ndim > 3:
+            axes = tuple(range(1, x.ndim - 1))
+        else:  # 1D case: (batch, steps, dim)
+            axes = (1,)
+        fn = jnp.max if self._reduce == "max" else jnp.mean
+        return fn(x, axis=axes)
+
+
+class GlobalMaxPooling1D(_GlobalPool):
+    _reduce: str = "max"
+
+
+class GlobalAveragePooling1D(_GlobalPool):
+    _reduce: str = "mean"
+
+
+class GlobalMaxPooling2D(_GlobalPool):
+    _reduce: str = "max"
+
+
+class GlobalAveragePooling2D(_GlobalPool):
+    _reduce: str = "mean"
+
+
+class GlobalMaxPooling3D(_GlobalPool):
+    _reduce: str = "max"
+
+
+class GlobalAveragePooling3D(_GlobalPool):
+    _reduce: str = "mean"

@@ -1,0 +1,158 @@
+"""Recurrent layers (parity: pyzoo/zoo/pipeline/api/keras/layers/recurrent.py
+SimpleRNN/LSTM/GRU, convolutional_recurrent.py ConvLSTM2D, wrappers.py
+Bidirectional/TimeDistributed).
+
+TPU-first: the time loop is a ``flax.linen.scan`` — one compiled cell body,
+XLA unrolls nothing, activations stream through VMEM. Static sequence length
+(XLA requirement); ragged batches are pad-and-masked by the data layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import activations
+from ..engine.graph import call_layer, keras_call
+
+
+class SimpleRNN(nn.Module):
+    """reference recurrent.py SimpleRNN."""
+    output_dim: int = 1
+    activation: Union[str, Callable] = "tanh"
+    return_sequences: bool = False
+    go_backwards: bool = False
+    W_regularizer: Any = None
+    U_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        act = activations.get(self.activation)
+        cell = nn.SimpleCell(features=self.output_dim, activation_fn=act)
+        out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
+        return out if self.return_sequences else out[:, -1, :]
+
+
+class LSTM(nn.Module):
+    """reference recurrent.py LSTM."""
+    output_dim: int = 1
+    activation: Union[str, Callable] = "tanh"
+    inner_activation: Union[str, Callable] = "hard_sigmoid"
+    return_sequences: bool = False
+    go_backwards: bool = False
+    W_regularizer: Any = None
+    U_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        cell = nn.LSTMCell(
+            features=self.output_dim,
+            activation_fn=activations.get(self.activation),
+            gate_fn=activations.get(self.inner_activation))
+        out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
+        return out if self.return_sequences else out[:, -1, :]
+
+
+class GRU(nn.Module):
+    """reference recurrent.py GRU."""
+    output_dim: int = 1
+    activation: Union[str, Callable] = "tanh"
+    inner_activation: Union[str, Callable] = "hard_sigmoid"
+    return_sequences: bool = False
+    go_backwards: bool = False
+    W_regularizer: Any = None
+    U_regularizer: Any = None
+    b_regularizer: Any = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        cell = nn.GRUCell(
+            features=self.output_dim,
+            activation_fn=activations.get(self.activation),
+            gate_fn=activations.get(self.inner_activation))
+        out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
+        return out if self.return_sequences else out[:, -1, :]
+
+
+class ConvLSTM2D(nn.Module):
+    """reference convolutional_recurrent.py ConvLSTM2D. Input
+    (batch, time, rows, cols, channels) channels-last (th inputs: transpose
+    upstream). Square kernel like the reference (nb_kernel)."""
+    nb_filter: int = 1
+    nb_kernel: int = 3
+    return_sequences: bool = False
+    go_backwards: bool = False
+    border_mode: str = "same"
+    subsample: Tuple[int, int] = (1, 1)
+    dim_ordering: str = "th"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        if self.dim_ordering == "th":       # (b, t, c, h, w) -> (b, t, h, w, c)
+            x = jnp.moveaxis(x, 2, -1)
+        cell = nn.ConvLSTMCell(features=self.nb_filter,
+                               kernel_size=(self.nb_kernel, self.nb_kernel))
+        out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
+        if not self.return_sequences:
+            out = out[:, -1]
+            if self.dim_ordering == "th":
+                out = jnp.moveaxis(out, -1, 1)
+            return out
+        if self.dim_ordering == "th":
+            out = jnp.moveaxis(out, -1, 2)
+        return out
+
+
+class Bidirectional(nn.Module):
+    """reference wrappers.py Bidirectional: merge_mode concat/sum/mul/ave."""
+    layer: nn.Module = None
+    merge_mode: str = "concat"
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x):
+        import dataclasses
+        fwd = self.layer
+        bwd = dataclasses.replace(self.layer, go_backwards=True,
+                                  name=(self.layer.name or "rnn") + "_bwd")
+        yf = call_layer(fwd, x)
+        yb = call_layer(bwd, x)
+        if self.merge_mode == "concat":
+            return jnp.concatenate([yf, yb], axis=-1)
+        if self.merge_mode == "sum":
+            return yf + yb
+        if self.merge_mode == "mul":
+            return yf * yb
+        if self.merge_mode == "ave":
+            return (yf + yb) / 2.0
+        raise ValueError(f"unknown merge_mode {self.merge_mode!r}")
+
+
+class TimeDistributed(nn.Module):
+    """reference wrappers.py TimeDistributed: apply a layer to every timestep.
+    Uses one set of params shared over time (folded batch dims), exactly the
+    XLA-friendly formulation."""
+    layer: nn.Module = None
+    input_shape: Any = None
+
+    @keras_call
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y = call_layer(self.layer, flat, train=train)
+        return y.reshape((b, t) + y.shape[1:])

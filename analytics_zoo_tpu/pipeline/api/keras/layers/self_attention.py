@@ -1,0 +1,173 @@
+"""Transformer / BERT layers (parity: pyzoo/zoo/pipeline/api/keras/layers/
+self_attention.py TransformerLayer:386 and BERT; Scala
+zoo/.../keras/layers/BERT.scala:402).
+
+TPU-first: attention routes through ops/attention.py — the Pallas flash
+kernel on-chip — and can shard the sequence over the mesh's ``sp`` axis with
+ring or Ulysses attention (parallel/ring_attention.py), which the reference
+cannot do at all (SURVEY.md §2.3 "Long-context/SP: ABSENT")."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.attention import flash_attention, mha_reference
+from ..engine.graph import keras_call
+
+
+class MultiHeadAttention(nn.Module):
+    """Projections + attention core with a pluggable strategy:
+    ``full`` | ``flash`` | ``ring`` | ``ulysses`` (the last two run under an
+    ``sp``-mapped shard_map context)."""
+    n_head: int = 12
+    hidden_size: int = 768
+    attn_dropout: float = 0.0
+    causal: bool = False
+    strategy: str = "flash"
+    sp_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        b, s, _ = x.shape
+        h, hs = self.n_head, self.hidden_size
+        d = hs // h
+        qkv = nn.Dense(3 * hs, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, d)
+        k = k.reshape(b, s, h, d)
+        v = v.reshape(b, s, h, d)
+        if self.strategy == "ring":
+            from analytics_zoo_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, axis_name=self.sp_axis,
+                                 causal=self.causal)
+        elif self.strategy == "ulysses":
+            from analytics_zoo_tpu.parallel.ring_attention import ulysses_attention
+            out = ulysses_attention(q, k, v, axis_name=self.sp_axis,
+                                    causal=self.causal)
+        elif self.strategy == "flash" and mask is None:
+            out = flash_attention(q, k, v, causal=self.causal)
+        else:
+            bias = None
+            if mask is not None:
+                # mask: (b, s) 1=keep -> additive bias broadcast over heads
+                bias = (1.0 - mask[:, None, None, :]) * -1e9
+            out = mha_reference(q, k, v, causal=self.causal, bias=bias)
+        out = out.reshape(b, s, hs)
+        out = nn.Dense(hs, name="proj")(out)
+        if self.attn_dropout:
+            out = nn.Dropout(self.attn_dropout, deterministic=not train)(out)
+        return out
+
+
+class TransformerBlock(nn.Module):
+    n_head: int = 12
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    hidden_drop: float = 0.1
+    attn_drop: float = 0.1
+    causal: bool = False
+    after_norm: bool = True          # BERT-style post-norm like the reference
+    activation: str = "gelu"
+    strategy: str = "flash"
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        attn = MultiHeadAttention(
+            n_head=self.n_head, hidden_size=self.hidden_size,
+            attn_dropout=self.attn_drop, causal=self.causal,
+            strategy=self.strategy, name="attention")(x, mask, train=train)
+        if self.hidden_drop:
+            attn = nn.Dropout(self.hidden_drop,
+                              deterministic=not train)(attn)
+        x = nn.LayerNorm(epsilon=1e-5, name="norm1")(x + attn)
+        act = (jax.nn.gelu if self.activation == "gelu" else jax.nn.relu)
+        ff = nn.Dense(self.intermediate_size, name="ffn_in")(x)
+        ff = act(ff)
+        ff = nn.Dense(self.hidden_size, name="ffn_out")(ff)
+        if self.hidden_drop:
+            ff = nn.Dropout(self.hidden_drop, deterministic=not train)(ff)
+        return nn.LayerNorm(epsilon=1e-5, name="norm2")(x + ff)
+
+
+class TransformerLayer(nn.Module):
+    """GPT-style decoder stack (reference self_attention.py TransformerLayer:
+    init(vocab, seq_len, n_block, ...)). Input: int token ids (b, s) or
+    (b, s) + position ids; output: (b, s, hidden)."""
+    vocab: int = 40990
+    seq_len: int = 77
+    n_block: int = 12
+    n_head: int = 12
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None
+    hidden_drop: float = 0.1
+    attn_drop: float = 0.1
+    embedding_drop: float = 0.1
+    mask_attention: bool = True
+    strategy: str = "flash"
+
+    @keras_call
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        hs = self.hidden_size
+        tok = nn.Embed(self.vocab, hs, name="token_embedding")(
+            ids.astype(jnp.int32))
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02), (self.seq_len, hs))
+        x = tok + pos[None, :tok.shape[1]]
+        if self.embedding_drop:
+            x = nn.Dropout(self.embedding_drop, deterministic=not train)(x)
+        inter = self.intermediate_size or 4 * hs
+        for i in range(self.n_block):
+            x = TransformerBlock(
+                n_head=self.n_head, hidden_size=hs, intermediate_size=inter,
+                hidden_drop=self.hidden_drop, attn_drop=self.attn_drop,
+                causal=self.mask_attention, strategy=self.strategy,
+                name=f"block_{i}")(x, train=train)
+        return x
+
+
+class BERT(nn.Module):
+    """BERT encoder (reference self_attention.py BERT / BERT.scala:402).
+    Inputs: token ids, token type ids, optional attention mask (1=keep).
+    Returns (sequence_output, pooled_output)."""
+    vocab: int = 40990
+    hidden_size: int = 768
+    n_block: int = 12
+    n_head: int = 12
+    seq_len: int = 512
+    intermediate_size: int = 3072
+    hidden_p_drop: float = 0.1
+    attn_p_drop: float = 0.1
+    strategy: str = "flash"
+
+    @keras_call
+    @nn.compact
+    def __call__(self, ids, token_type_ids=None, attention_mask=None,
+                 train: bool = False):
+        hs = self.hidden_size
+        ids = ids.astype(jnp.int32)
+        tok = nn.Embed(self.vocab, hs, name="token_embedding")(ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(ids)
+        seg = nn.Embed(2, hs, name="segment_embedding")(
+            token_type_ids.astype(jnp.int32))
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02), (self.seq_len, hs))
+        x = tok + seg + pos[None, :ids.shape[1]]
+        x = nn.LayerNorm(epsilon=1e-12, name="embedding_norm")(x)
+        if self.hidden_p_drop:
+            x = nn.Dropout(self.hidden_p_drop, deterministic=not train)(x)
+        strategy = self.strategy if attention_mask is None else "full"
+        for i in range(self.n_block):
+            x = TransformerBlock(
+                n_head=self.n_head, hidden_size=hs,
+                intermediate_size=self.intermediate_size,
+                hidden_drop=self.hidden_p_drop, attn_drop=self.attn_p_drop,
+                causal=False, strategy=strategy,
+                name=f"block_{i}")(x, attention_mask, train=train)
+        pooled = jnp.tanh(nn.Dense(hs, name="pooler")(x[:, 0]))
+        return x, pooled

@@ -8,11 +8,22 @@ backend is JAX CPU with xla_force_host_platform_device_count=8.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the shell points JAX_PLATFORMS at a real TPU: the test
+# suite needs the 8-device virtual mesh, and bench.py owns the real chip.
+# sitecustomize may have imported jax already (capturing JAX_PLATFORMS from
+# the env), so set it through jax.config, not just the environment.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# This platform's default matmul precision is bf16-grade even on CPU; pin
+# full f32 suite-wide so numeric-equivalence tests are order-independent.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 

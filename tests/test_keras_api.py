@@ -1,0 +1,190 @@
+"""Keras-style pipeline API: layers, Sequential, functional Model, autograd,
+compile/fit round trips (reference test models:
+pyzoo/test/zoo/pipeline/api/keras/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api import autograd as A
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    BERT, BatchNormalization, Bidirectional, Convolution1D, Convolution2D,
+    Dense, Dropout, Embedding, Flatten, GRU, GlobalAveragePooling2D,
+    GlobalMaxPooling1D, Highway, LSTM, LeakyReLU, MaxPooling2D, MaxoutDense,
+    Merge, PReLU, Permute, Reshape, SimpleRNN, SpatialDropout1D, Squeeze,
+    TimeDistributed, TransformerLayer, UpSampling2D, WordEmbedding,
+    ZeroPadding2D, merge)
+
+
+def _init_apply(module, *xs, train=False):
+    rngs = {"params": jax.random.PRNGKey(0),
+            "dropout": jax.random.PRNGKey(1)}
+    variables = module.init(rngs, *xs)
+    return module.apply(variables, *xs)
+
+
+def test_sequential_stack_shapes():
+    m = Sequential([Dense(8, activation="relu"), Dropout(0.3), Dense(3)])
+    out = _init_apply(m.to_module(), jnp.ones((4, 16)))
+    assert out.shape == (4, 3)
+
+
+def test_sequential_add_api():
+    m = Sequential()
+    m.add(Dense(4, activation="tanh"))
+    m.add(Dense(2))
+    out = _init_apply(m.to_module(), jnp.ones((2, 6)))
+    assert out.shape == (2, 2)
+
+
+def test_conv_stack_th_ordering():
+    m = Sequential([
+        Convolution2D(4, 3, 3, dim_ordering="th", activation="relu"),
+        MaxPooling2D(dim_ordering="th"),
+        Flatten(), Dense(5)])
+    out = _init_apply(m.to_module(), jnp.ones((2, 1, 12, 12)))
+    assert out.shape == (2, 5)
+
+
+def test_conv_matches_channels_last():
+    """th and tf orderings compute the same function modulo transpose."""
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    th = Convolution2D(4, 3, 3, dim_ordering="th")
+    tf = Convolution2D(4, 3, 3, dim_ordering="tf")
+    rngs = {"params": jax.random.PRNGKey(0)}
+    v_th = th.init(rngs, jnp.asarray(x))
+    y_th = th.apply(v_th, jnp.asarray(x))
+    y_tf = tf.apply(v_th, jnp.moveaxis(jnp.asarray(x), 1, -1))
+    np.testing.assert_allclose(np.asarray(jnp.moveaxis(y_th, 1, -1)),
+                               np.asarray(y_tf), rtol=1e-5, atol=1e-5)
+
+
+def test_recurrent_layers():
+    x = jnp.ones((2, 5, 3))
+    assert _init_apply(LSTM(4), x).shape == (2, 4)
+    assert _init_apply(GRU(4, return_sequences=True), x).shape == (2, 5, 4)
+    assert _init_apply(SimpleRNN(6), x).shape == (2, 6)
+    assert _init_apply(Bidirectional(LSTM(4, return_sequences=True)),
+                       x).shape == (2, 5, 8)
+    assert _init_apply(TimeDistributed(Dense(7)), x).shape == (2, 5, 7)
+
+
+def test_misc_layers():
+    x = jnp.ones((2, 4, 6))
+    assert _init_apply(Permute((2, 1)), x).shape == (2, 6, 4)
+    assert _init_apply(Reshape((24,)), x).shape == (2, 24)
+    assert _init_apply(GlobalMaxPooling1D(), x).shape == (2, 6)
+    assert _init_apply(Highway(), jnp.ones((2, 5))).shape == (2, 5)
+    assert _init_apply(MaxoutDense(4, nb_feature=3),
+                       jnp.ones((2, 5))).shape == (2, 4)
+    assert _init_apply(PReLU(), jnp.ones((2, 5))).shape == (2, 5)
+    assert _init_apply(LeakyReLU(), jnp.ones((2, 5))).shape == (2, 5)
+    img = jnp.ones((2, 3, 4, 4))
+    assert _init_apply(ZeroPadding2D(dim_ordering="th"),
+                       img).shape == (2, 3, 6, 6)
+    assert _init_apply(UpSampling2D(dim_ordering="th"),
+                       img).shape == (2, 3, 8, 8)
+    assert _init_apply(GlobalAveragePooling2D(dim_ordering="th"),
+                       img).shape == (2, 3)
+    assert _init_apply(BatchNormalization(dim_ordering="th"),
+                       img).shape == (2, 3, 4, 4)
+
+
+def test_embedding_lookup():
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = _init_apply(Embedding(10, 4), ids)
+    assert out.shape == (1, 3, 4)
+    mat = np.random.randn(10, 4).astype(np.float32)
+    out2 = _init_apply(WordEmbedding(embedding_matrix=mat), ids)
+    np.testing.assert_allclose(np.asarray(out2[0, 0]), mat[1], rtol=1e-6)
+
+
+def test_functional_model_and_merge():
+    inp = Input(shape=(16,))
+    a = Dense(8, activation="relu")(inp)
+    b = Dense(8)(inp)
+    out = merge([a, b], mode="concat")
+    model = Model(inp, out)
+    y = _init_apply(model.to_module(), jnp.ones((4, 16)))
+    assert y.shape == (4, 16)
+
+
+def test_functional_multi_input():
+    i1, i2 = Input(shape=(4,)), Input(shape=(4,))
+    out = Merge(mode="sum")(Dense(3)(i1), Dense(3)(i2))
+    model = Model([i1, i2], out)
+    y = _init_apply(model.to_module(), jnp.ones((2, 4)), jnp.ones((2, 4)))
+    assert y.shape == (2, 3)
+
+
+def test_weight_sharing_in_graph():
+    """Calling one layer instance twice shares parameters."""
+    inp = Input(shape=(4,))
+    shared = Dense(3, use_bias=False)
+    y = Merge(mode="sum")(shared(inp), shared(inp))
+    model = Model(inp, y).to_module()
+    v = model.init({"params": jax.random.PRNGKey(0)}, jnp.ones((2, 4)))
+    leaves = jax.tree.leaves(v["params"])
+    assert len(leaves) == 1          # one kernel only
+    x = jnp.ones((2, 4))
+    direct = shared.apply(
+        {"params": jax.tree.map(lambda a: a, list(
+            v["params"].values())[0])}, x)
+    np.testing.assert_allclose(np.asarray(model.apply(v, x)),
+                               np.asarray(2 * direct), rtol=1e-6)
+
+
+def test_autograd_expression():
+    inp = Input(shape=(8,))
+    a = Dense(4)(inp)
+    b = Dense(4)(inp)
+    expr = A.mean(A.square(a - b), axis=1)
+    model = Model(inp, expr).to_module()
+    y = _init_apply(model, jnp.ones((3, 8)))
+    assert y.shape == (3,)
+    assert bool(jnp.all(y >= 0))
+
+
+def test_autograd_ops_eager():
+    x = jnp.asarray([-2.0, 3.0])
+    assert float(A.abs(x)[0]) == 2.0
+    assert float(A.sum(x)) == 1.0
+    assert A.clip(x, -1, 1).tolist() == [-1.0, 1.0]
+    np.testing.assert_allclose(np.asarray(A.maximum(x, 0.0)), [0.0, 3.0])
+
+
+def test_lambda_layer():
+    inp = Input(shape=(5,))
+    out = A.Lambda(lambda t: jnp.tanh(t) * 2)(inp)
+    model = Model(inp, out).to_module()
+    y = _init_apply(model, jnp.ones((2, 5)))
+    np.testing.assert_allclose(np.asarray(y),
+                               np.tanh(np.ones((2, 5))) * 2, rtol=1e-6)
+
+
+def test_transformer_and_bert_shapes():
+    ids = jnp.ones((2, 8), jnp.int32)
+    t = TransformerLayer(vocab=50, seq_len=8, n_block=1, n_head=2,
+                         hidden_size=16, strategy="full")
+    assert _init_apply(t, ids).shape == (2, 8, 16)
+    b = BERT(vocab=50, hidden_size=16, n_block=1, n_head=2, seq_len=8,
+             intermediate_size=32, strategy="full")
+    seq, pooled = _init_apply(b, ids)
+    assert seq.shape == (2, 8, 16) and pooled.shape == (2, 16)
+
+
+def test_compile_fit_predict(orca_context):
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 10).astype(np.float32)
+    w = rng.randn(10, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    m = Sequential([Dense(8, activation="relu"), Dense(1)])
+    m.compile(optimizer="adam", loss="mean_squared_error")
+    stats = m.fit(x, y, batch_size=32, nb_epoch=3, verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+    preds = m.predict(x, batch_size=32)
+    assert np.asarray(preds).shape == (64, 1)
+    res = m.evaluate(x, y, batch_size=32)
+    assert "loss" in res

@@ -1,0 +1,119 @@
+"""Built-in model zoo: shapes, training round-trips, and reference helper
+semantics (reference tests: pyzoo/test/zoo/models/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import (
+    AnomalyDetector, AnomalyDetectorNet, ColumnFeatureInfo, KNRM, KNRMNet,
+    Seq2Seq, Seq2SeqNet, SessionRecommender, TextClassifier,
+    TextClassifierNet, WideAndDeep)
+
+
+def _init_apply(module, *xs):
+    v = module.init({"params": jax.random.PRNGKey(0)}, *xs)
+    return module.apply(v, *xs)
+
+
+@pytest.mark.parametrize("encoder", ["cnn", "lstm", "gru"])
+def test_text_classifier_encoders(encoder):
+    net = TextClassifierNet(class_num=4, vocab_size=50, embed_dim=8,
+                            encoder=encoder, encoder_output_dim=6)
+    out = _init_apply(net, jnp.ones((2, 20), jnp.int32))
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_text_classifier_fit(orca_context):
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 50, (32, 10)).astype(np.int32)
+    y = rng.randint(0, 3, 32).astype(np.int32)
+    clf = TextClassifier(class_num=3, vocab_size=50, embed_dim=8,
+                         sequence_length=10, encoder="cnn",
+                         encoder_output_dim=6)
+    clf.compile(loss="sparse_categorical_crossentropy", optimizer="adam")
+    stats = clf.fit({"x": x, "y": y}, epochs=2, batch_size=16, verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+    preds = clf.predict(x)
+    assert preds.shape == (32, 3)
+
+
+def test_knrm_ranking_and_classification():
+    ids = jnp.ones((2, 15), jnp.int32)
+    rank = KNRMNet(text1_length=5, text2_length=10, vocab_size=50,
+                   embed_size=8, target_mode="ranking")
+    assert _init_apply(rank, ids).shape == (2, 1)
+    cls = KNRMNet(text1_length=5, text2_length=10, vocab_size=50,
+                  embed_size=8, target_mode="classification")
+    out = np.asarray(_init_apply(cls, ids))
+    assert ((out >= 0) & (out <= 1)).all()
+
+
+def test_knrm_ndcg_map():
+    from analytics_zoo_tpu.models.common.ranker import (
+        mean_average_precision, ndcg)
+    labels = np.array([1, 0, 1, 0])
+    perfect = np.array([4.0, 1.0, 3.0, 0.5])
+    assert ndcg(labels, perfect, k=4) == pytest.approx(1.0)
+    assert mean_average_precision(labels, perfect) == pytest.approx(1.0)
+    worst = -perfect
+    assert ndcg(labels, worst, k=4) < 1.0
+
+
+def test_wide_and_deep_types(orca_context):
+    ci = ColumnFeatureInfo(
+        wide_base_cols=["a"], wide_base_dims=[10],
+        indicator_cols=["b"], indicator_dims=[4],
+        embed_cols=["c"], embed_in_dims=[20], embed_out_dims=[8],
+        continuous_cols=["d"])
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, ci.feature_width()).astype(np.float32)
+    y = rng.randint(0, 2, 32).astype(np.int32)
+    for mtype in ("wide", "deep", "wide_n_deep"):
+        model = WideAndDeep(2, ci, model_type=mtype)
+        model.compile(loss="sparse_categorical_crossentropy",
+                      optimizer="adam")
+        stats = model.fit({"x": x, "y": y}, epochs=1, batch_size=16,
+                          verbose=False)
+        assert np.isfinite(stats[-1]["train_loss"])
+
+
+def test_session_recommender_topk():
+    sr = SessionRecommender(item_count=30, item_embed=8,
+                            rnn_hidden_layers=[10], session_length=5)
+    sess = np.random.RandomState(0).randint(1, 31, (4, 5)).astype(np.int32)
+    recs = sr.recommend_for_session(sess, max_items=3)
+    assert len(recs) == 4 and len(recs[0]) == 3
+    # scores descending
+    scores = [s for _, s in recs[0]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_anomaly_detector_pipeline(orca_context):
+    ts = np.sin(np.linspace(0, 20, 200)).astype(np.float32).reshape(-1, 1)
+    x, y = AnomalyDetector.unroll(ts, unroll_length=10)
+    ad = AnomalyDetector(feature_shape=(10, 1), hidden_layers=[8, 4],
+                         dropouts=[0.1, 0.1])
+    ad.compile(loss="mean_squared_error", optimizer="adam")
+    ad.fit({"x": x, "y": y}, epochs=1, batch_size=32, verbose=False)
+    preds = ad.predict(x)
+    anomalies = AnomalyDetector.detect_anomalies(y, preds, 5)
+    assert len(anomalies) >= 5
+
+
+def test_seq2seq_teacher_forcing_and_infer(orca_context):
+    rng = np.random.RandomState(0)
+    src = rng.randint(1, 20, (16, 7)).astype(np.int32)
+    tgt_in = rng.randint(1, 25, (16, 5)).astype(np.int32)
+    tgt_out = rng.randint(0, 25, (16, 5)).astype(np.int32)
+    s2s = Seq2Seq(rnn_type="gru", nlayers=1, hidden_size=8, src_vocab=20,
+                  tgt_vocab=25, embed_dim=8)
+    s2s.compile(loss="sparse_categorical_crossentropy", optimizer="adam")
+    stats = s2s.fit({"x": (src, tgt_in), "y": tgt_out}, epochs=1,
+                    batch_size=8, verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+    gen = s2s.infer(src[:2], start_sign=1, max_seq_len=6)
+    assert gen.shape == (2, 6)
+    assert (gen[:, 0] == 1).all()
