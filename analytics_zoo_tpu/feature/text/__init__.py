@@ -1,0 +1,2 @@
+from .text_set import (DistributedTextSet, LocalTextSet, TextFeature,
+                       TextSet)

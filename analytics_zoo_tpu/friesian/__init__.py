@@ -1,0 +1,1 @@
+from .feature import FeatureTable, StringIndex, Table

@@ -55,9 +55,9 @@ class SessionRecommenderNet(nn.Module):
 class SessionRecommender(ZooModel):
     def __init__(self, item_count, item_embed=100,
                  rnn_hidden_layers: Sequence[int] = (40, 20),
-                 session_length: int = 0, include_history: bool = False,
+                 session_length: int = 5, include_history: bool = False,
                  mlp_hidden_layers: Sequence[int] = (40, 20),
-                 history_length: int = 0, **_):
+                 history_length: int = 10, **_):
         module = SessionRecommenderNet(
             item_count=int(item_count), item_embed=int(item_embed),
             rnn_hidden_layers=tuple(int(u) for u in rnn_hidden_layers),

@@ -83,6 +83,33 @@ class TPUEstimator:
                                   self.mesh, seed=seed)
         self._trainer_state = TrainerState()
         self.train_stats: List[Dict[str, float]] = []
+        self._tb_train = None
+        self._tb_val = None
+
+    # --- tensorboard (reference: orca/learn/tf/estimator.py:167-220,
+    # pipeline/estimator/Estimator.scala:116-122) ----------------------------
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        from ...utils.tensorboard import FileWriter
+        self._tb_dir = os.path.join(log_dir, app_name)
+        self._tb_train = FileWriter(os.path.join(self._tb_dir, "train"))
+        self._tb_val = FileWriter(os.path.join(self._tb_dir, "validation"))
+        return self
+
+    def get_train_summary(self, tag: str = "Loss"):
+        from ...utils.tensorboard import read_scalars
+        if self._tb_train is None:
+            return []
+        self._tb_train.flush()
+        scalars = read_scalars(os.path.join(self._tb_dir, "train"))
+        return scalars.get(tag, [])
+
+    def get_validation_summary(self, tag: str):
+        from ...utils.tensorboard import read_scalars
+        if self._tb_val is None:
+            return []
+        self._tb_val.flush()
+        scalars = read_scalars(os.path.join(self._tb_dir, "validation"))
+        return scalars.get(tag, [])
 
     # --- fit ----------------------------------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
@@ -108,6 +135,7 @@ class TPUEstimator:
         for ep in range(epochs):
             t0 = time.time()
             losses = []
+            tb_steps = []
             nsteps = steps_per_epoch or it.steps_per_epoch
             for i, batch in enumerate(it.epoch()):
                 if i >= nsteps:
@@ -115,11 +143,20 @@ class TPUEstimator:
                 loss = self.engine.train_batch(batch)
                 losses.append(loss)
                 self._trainer_state.iteration += 1
+                if self._tb_train is not None:
+                    # keep the device array; flush with ONE device_get at
+                    # epoch end so logging never blocks async dispatch
+                    tb_steps.append(self._trainer_state.iteration)
                 if checkpoint_trigger and self.model_dir:
                     self._trainer_state.epoch_finished = False
                     if checkpoint_trigger(self._trainer_state):
                         self.save_checkpoint(self.model_dir)
-            mean_loss = float(np.mean(jax.device_get(losses)))
+            host_losses = jax.device_get(losses)
+            if self._tb_train is not None:
+                for step, lv in zip(tb_steps, host_losses):
+                    self._tb_train.add_scalar("Loss", float(lv), step)
+                self._tb_train.flush()
+            mean_loss = float(np.mean(host_losses))
             self._trainer_state.epoch += 1
             self._trainer_state.epoch_finished = True
             self._trainer_state.loss = mean_loss
@@ -134,6 +171,11 @@ class TPUEstimator:
                 stats.update({f"val_{k}": v for k, v in val.items()})
                 self._trainer_state.score = val.get(
                     next(iter(self.metrics), "loss"), val.get("loss"))
+                if self._tb_val is not None:
+                    for k, v in val.items():
+                        if isinstance(v, (int, float)):
+                            self._tb_val.add_scalar(
+                                k, float(v), self._trainer_state.iteration)
             if checkpoint_trigger and self.model_dir and \
                     checkpoint_trigger(self._trainer_state):
                 self.save_checkpoint(self.model_dir)

@@ -182,8 +182,10 @@ class LBFGS(Optimizer):
         return optax.lbfgs(self.lr)
 
 
-def convert_optimizer(opt) -> optax.GradientTransformation:
-    """Optimizer | optax transform | str -> optax transform."""
+def convert_optimizer(opt, learning_rate: float = None
+                      ) -> optax.GradientTransformation:
+    """Optimizer | optax transform | str -> optax transform. An explicit
+    learning_rate overrides a string optimizer's default."""
     if isinstance(opt, Optimizer):
         return opt.to_optax()
     if isinstance(opt, optax.GradientTransformation):
@@ -195,5 +197,13 @@ def convert_optimizer(opt) -> optax.GradientTransformation:
         key = opt.lower()
         if key not in table:
             raise ValueError(f"unknown optimizer '{opt}'")
-        return table[key]().to_optax()
+        kwargs = {}
+        if learning_rate is not None:
+            import inspect
+            params = inspect.signature(table[key].__init__).parameters
+            for name in ("lr", "learningrate"):
+                if name in params:
+                    kwargs[name] = learning_rate
+                    break
+        return table[key](**kwargs).to_optax()
     raise ValueError(f"cannot convert {opt!r} to an optimizer")

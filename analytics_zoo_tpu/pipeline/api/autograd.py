@@ -123,9 +123,7 @@ def mm(x, y, axes: Optional[Sequence[int]] = None):
     autograd.mm)."""
     def fn(a, b):
         if axes is not None:
-            return jnp.einsum(a, list(range(a.ndim)), b,
-                              list(range(a.ndim, a.ndim + b.ndim)),
-                              ) if False else jax.lax.dot_general(
+            return jax.lax.dot_general(
                 a, b, (((axes[0],), (axes[1],)), ((0,), (0,))))
         return jnp.matmul(a, b)
     return _binary(fn, "mm")(x, y)

@@ -91,13 +91,21 @@ class KerasNet:
                                               metrics=None)
             self._estimator = TPUEstimator(
                 self.to_module(), loss=args["loss"],
-                optimizer=args["optimizer"], metrics=args["metrics"],
-                model_dir=self._tb_dir)
+                optimizer=args["optimizer"], metrics=args["metrics"])
+            if self._tb_dir is not None:
+                self._estimator.set_tensorboard(*self._tb_dir)
         return self._estimator
 
     def set_tensorboard(self, log_dir: str, app_name: str):
-        import os
-        self._tb_dir = os.path.join(log_dir, app_name)
+        self._tb_dir = (log_dir, app_name)
+        if self._estimator is not None:
+            self._estimator.set_tensorboard(log_dir, app_name)
+
+    def get_train_summary(self, tag: str = "Loss"):
+        return self.estimator.get_train_summary(tag)
+
+    def get_validation_summary(self, tag: str):
+        return self.estimator.get_validation_summary(tag)
 
     def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
             validation_data=None, distributed: bool = True, **kwargs):

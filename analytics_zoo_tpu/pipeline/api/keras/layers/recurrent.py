@@ -36,7 +36,11 @@ class SimpleRNN(nn.Module):
         act = activations.get(self.activation)
         cell = nn.SimpleCell(features=self.output_dim, activation_fn=act)
         out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
-        return out if self.return_sequences else out[:, -1, :]
+        if self.return_sequences:
+            return out
+        # keep_order=True returns outputs in input order, so the final
+        # processed step sits at index 0 when scanning backwards.
+        return out[:, 0, :] if self.go_backwards else out[:, -1, :]
 
 
 class LSTM(nn.Module):
@@ -59,7 +63,11 @@ class LSTM(nn.Module):
             activation_fn=activations.get(self.activation),
             gate_fn=activations.get(self.inner_activation))
         out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
-        return out if self.return_sequences else out[:, -1, :]
+        if self.return_sequences:
+            return out
+        # keep_order=True returns outputs in input order, so the final
+        # processed step sits at index 0 when scanning backwards.
+        return out[:, 0, :] if self.go_backwards else out[:, -1, :]
 
 
 class GRU(nn.Module):
@@ -82,7 +90,11 @@ class GRU(nn.Module):
             activation_fn=activations.get(self.activation),
             gate_fn=activations.get(self.inner_activation))
         out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
-        return out if self.return_sequences else out[:, -1, :]
+        if self.return_sequences:
+            return out
+        # keep_order=True returns outputs in input order, so the final
+        # processed step sits at index 0 when scanning backwards.
+        return out[:, 0, :] if self.go_backwards else out[:, -1, :]
 
 
 class ConvLSTM2D(nn.Module):
@@ -107,7 +119,7 @@ class ConvLSTM2D(nn.Module):
                                kernel_size=(self.nb_kernel, self.nb_kernel))
         out = nn.RNN(cell, reverse=self.go_backwards, keep_order=True)(x)
         if not self.return_sequences:
-            out = out[:, -1]
+            out = out[:, 0] if self.go_backwards else out[:, -1]
             if self.dim_ordering == "th":
                 out = jnp.moveaxis(out, -1, 1)
             return out

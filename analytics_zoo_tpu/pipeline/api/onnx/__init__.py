@@ -1,0 +1,1 @@
+from .onnx_loader import ONNXModule, load, load_onnx, parse_onnx

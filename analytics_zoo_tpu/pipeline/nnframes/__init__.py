@@ -1,0 +1,3 @@
+from .nn_classifier import (NNClassifier, NNClassifierModel, NNEstimator,
+                            NNModel)
+from .nn_image_reader import NNImageReader

@@ -1,0 +1,184 @@
+"""NNFrames — DataFrame ML pipeline API (parity: pyzoo/zoo/pipeline/nnframes/
+nn_classifier.py — NNEstimator:139, NNModel:517, NNClassifier:613,
+NNClassifierModel:660; Scala nnframes/NNEstimator.scala:202).
+
+The reference wraps Spark ML Estimator/Transformer over Spark DataFrames;
+here the same fit(df) -> model, model.transform(df) -> df-with-prediction
+contract runs on pandas DataFrames over the one TPU engine. Feature/label
+preprocessing mirrors the SeqToTensor/ArrayToTensor converters."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+
+def _col_to_array(df: pd.DataFrame, col: str) -> np.ndarray:
+    vals = df[col].to_numpy()
+    if len(vals) and isinstance(vals[0], (list, tuple, np.ndarray)):
+        return np.stack([np.asarray(v, np.float32) for v in vals])
+    return vals.astype(np.float32).reshape(-1, 1)
+
+
+class NNEstimator:
+    """fit(df) trains the flax module on featuresCol/labelCol.
+
+    Parameters mirror the reference: model, criterion (loss), plus optional
+    feature_preprocessing sizes (accepted for API parity; shapes are derived
+    from the data)."""
+
+    def __init__(self, model, criterion="mean_squared_error",
+                 feature_preprocessing=None, label_preprocessing=None):
+        self.model = model
+        self.criterion = criterion
+        self._features_col = "features"
+        self._label_col = "label"
+        self._predictions_col = "prediction"
+        self._batch_size = 32
+        self._max_epoch = 10
+        self._optim_method = "adam"
+        self._learning_rate = 1e-3
+        self._caching_sample = True
+
+    # --- Spark-ML style setters (reference NNEstimator setters) -------------
+    def setFeaturesCol(self, name: str) -> "NNEstimator":
+        self._features_col = name
+        return self
+
+    def setLabelCol(self, name: str) -> "NNEstimator":
+        self._label_col = name
+        return self
+
+    def setPredictionCol(self, name: str) -> "NNEstimator":
+        self._predictions_col = name
+        return self
+
+    def setBatchSize(self, bs: int) -> "NNEstimator":
+        self._batch_size = int(bs)
+        return self
+
+    def setMaxEpoch(self, n: int) -> "NNEstimator":
+        self._max_epoch = int(n)
+        return self
+
+    def setOptimMethod(self, opt) -> "NNEstimator":
+        self._optim_method = opt
+        return self
+
+    def setLearningRate(self, lr: float) -> "NNEstimator":
+        self._learning_rate = float(lr)
+        return self
+
+    def setCachingSample(self, b: bool) -> "NNEstimator":
+        self._caching_sample = bool(b)
+        return self
+
+    # snake_case aliases
+    set_features_col = setFeaturesCol
+    set_label_col = setLabelCol
+    set_batch_size = setBatchSize
+    set_max_epoch = setMaxEpoch
+
+    def _make_estimator(self):
+        from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+        opt = self._optim_method
+        if isinstance(opt, str) and self._learning_rate:
+            from analytics_zoo_tpu.orca.learn.optimizers.optimizers_impl \
+                import convert_optimizer
+            opt = convert_optimizer(opt, learning_rate=self._learning_rate)
+        return TPUEstimator(self.model, loss=self.criterion, optimizer=opt)
+
+    def _label_array(self, df: pd.DataFrame) -> np.ndarray:
+        y = _col_to_array(df, self._label_col)
+        return y
+
+    def fit(self, df: pd.DataFrame) -> "NNModel":
+        x = _col_to_array(df, self._features_col)
+        y = self._label_array(df)
+        est = self._make_estimator()
+        est.fit({"x": x, "y": y}, epochs=self._max_epoch,
+                batch_size=self._batch_size, verbose=False)
+        return self._make_model(est)
+
+    def _make_model(self, est) -> "NNModel":
+        m = NNModel(self.model, estimator=est)
+        m._features_col = self._features_col
+        m._predictions_col = self._predictions_col
+        m._batch_size = self._batch_size
+        return m
+
+
+class NNModel:
+    """transform(df) appends the prediction column (reference NNModel:517)."""
+
+    def __init__(self, model, estimator=None):
+        self.model = model
+        if estimator is None:
+            from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+            estimator = TPUEstimator(model, loss="mean_squared_error",
+                                     optimizer="adam")
+        self.estimator = estimator
+        self._features_col = "features"
+        self._predictions_col = "prediction"
+        self._batch_size = 32
+
+    def setFeaturesCol(self, name: str) -> "NNModel":
+        self._features_col = name
+        return self
+
+    def setPredictionCol(self, name: str) -> "NNModel":
+        self._predictions_col = name
+        return self
+
+    def setBatchSize(self, bs: int) -> "NNModel":
+        self._batch_size = int(bs)
+        return self
+
+    def _predict_array(self, df: pd.DataFrame) -> np.ndarray:
+        x = _col_to_array(df, self._features_col)
+        return np.asarray(self.estimator.predict(
+            {"x": x}, batch_size=self._batch_size))
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        preds = self._predict_array(df)
+        out = df.copy()
+        out[self._predictions_col] = list(preds)
+        return out
+
+    def save(self, path: str):
+        self.estimator.save(path)
+
+    @classmethod
+    def load(cls, model, path: str) -> "NNModel":
+        m = cls(model)
+        m.estimator.load(path)
+        return m
+
+
+class NNClassifier(NNEstimator):
+    """Classification specialisation (reference NNClassifier:613): labels are
+    class ids; prediction is argmax."""
+
+    def __init__(self, model, criterion="sparse_categorical_crossentropy",
+                 feature_preprocessing=None):
+        super().__init__(model, criterion, feature_preprocessing)
+
+    def _label_array(self, df: pd.DataFrame) -> np.ndarray:
+        return df[self._label_col].to_numpy().astype(np.int32)
+
+    def _make_model(self, est) -> "NNClassifierModel":
+        m = NNClassifierModel(self.model, estimator=est)
+        m._features_col = self._features_col
+        m._predictions_col = self._predictions_col
+        m._batch_size = self._batch_size
+        return m
+
+
+class NNClassifierModel(NNModel):
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        probs = self._predict_array(df)
+        out = df.copy()
+        out[self._predictions_col] = np.argmax(probs, -1).astype(np.int64)
+        return out

@@ -1,0 +1,61 @@
+"""NNImageReader (parity: pyzoo/zoo/pipeline/nnframes/nn_image_reader.py:25 —
+read image files into a DataFrame with an image-struct column)."""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+
+def _decode(path: str) -> Optional[dict]:
+    try:
+        from PIL import Image
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            arr = np.asarray(im, np.uint8)
+        return {"origin": path, "height": arr.shape[0],
+                "width": arr.shape[1], "nChannels": arr.shape[2],
+                "mode": 16, "data": arr}
+    except ImportError:
+        # PIL not in the image: fall back to raw bytes record
+        with open(path, "rb") as f:
+            data = f.read()
+        return {"origin": path, "height": -1, "width": -1, "nChannels": -1,
+                "mode": -1, "data": np.frombuffer(data, np.uint8)}
+    except Exception:
+        return None
+
+
+class NNImageReader:
+    @staticmethod
+    def readImages(path: str, min_partitions: int = 1,
+                   resize_height: int = -1, resize_width: int = -1,
+                   image_codec: int = -1) -> pd.DataFrame:
+        if os.path.isdir(path):
+            files = sorted(
+                p for p in glob.glob(os.path.join(path, "**", "*"),
+                                     recursive=True) if os.path.isfile(p))
+        else:
+            files = sorted(glob.glob(path))
+        rows = []
+        for p in files:
+            rec = _decode(p)
+            if rec is None:
+                continue
+            if resize_height > 0 and resize_width > 0 and rec["height"] > 0:
+                try:
+                    from PIL import Image
+                    im = Image.fromarray(rec["data"]).resize(
+                        (resize_width, resize_height))
+                    rec["data"] = np.asarray(im, np.uint8)
+                    rec["height"], rec["width"] = resize_height, resize_width
+                except ImportError:
+                    pass
+            rows.append({"image": rec})
+        return pd.DataFrame(rows)
+
+    read_images = readImages

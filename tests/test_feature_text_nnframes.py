@@ -1,0 +1,142 @@
+"""TextSet pipeline, NNFrames DataFrame estimators, TensorBoard writer
+(reference tests: pyzoo/test/zoo/feature/text/, pyzoo/test/zoo/pipeline/
+nnframes/, Scala tensorboard specs)."""
+
+import flax.linen as nn
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.feature.text import TextSet
+from analytics_zoo_tpu.pipeline.nnframes import (NNClassifier, NNEstimator,
+                                                 NNModel)
+
+
+TEXTS = ["The quick brown fox jumps over the lazy dog",
+         "the cat sat on the mat",
+         "dogs and cats living together",
+         "never gonna give you up"]
+
+
+def test_textset_pipeline():
+    ts = TextSet.from_texts(TEXTS, labels=[0, 1, 1, 0])
+    ts.tokenize().normalize().word2idx().shape_sequence(len=6)
+    x, y = ts.to_arrays()
+    assert x.shape == (4, 6) and x.dtype == np.int32
+    assert list(y) == [0, 1, 1, 0]
+    vocab = ts.get_word_index()
+    assert vocab["the"] == 1          # most frequent word -> id 1
+    assert all(v >= 1 for v in vocab.values())
+
+
+def test_textset_word2idx_options():
+    ts = TextSet.from_texts(TEXTS)
+    ts.tokenize().normalize()
+    ts.word2idx(remove_topN=1, max_words_num=5)
+    vocab = ts.get_word_index()
+    assert "the" not in vocab
+    assert len(vocab) == 5
+    # unseen words map to 0
+    ts2 = TextSet.from_texts(["completely novel phrasing"])
+    ts2.tokenize().normalize().word2idx(existing_map=vocab)
+    ts2.shape_sequence(len=4)
+    x, _ = ts2.to_arrays()
+    assert (x == 0).all()
+
+
+def test_textset_shape_sequence_trunc_modes():
+    ts = TextSet.from_texts(["a b c d e f"])
+    ts.tokenize().word2idx()
+    pre = [f.indices.copy() for f in ts.shape_sequence(len=3).features][0]
+    ts2 = TextSet.from_texts(["a b c d e f"])
+    ts2.tokenize().word2idx()
+    post = [f.indices.copy()
+            for f in ts2.shape_sequence(len=3, trunc_mode="post").features][0]
+    assert len(pre) == 3 and len(post) == 3
+    assert not np.array_equal(pre, post)
+
+
+def test_textset_save_load_word_index(tmp_path):
+    ts = TextSet.from_texts(TEXTS).tokenize().normalize().word2idx()
+    p = str(tmp_path / "vocab.pkl")
+    ts.save_word_index(p)
+    ts2 = TextSet.from_texts(["x"]).load_word_index(p)
+    assert ts2.get_word_index() == ts.get_word_index()
+
+
+def test_textset_random_split():
+    ts = TextSet.from_texts(TEXTS * 5)
+    a, b = ts.random_split([0.75, 0.25])
+    assert len(a.features) + len(b.features) == 20
+    assert len(a.features) == 15
+
+
+class _MLP(nn.Module):
+    out: int = 1
+    softmax: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(nn.Dense(8)(x))
+        y = nn.Dense(self.out)(h)
+        return nn.softmax(y) if self.softmax else y
+
+
+def test_nnestimator_fit_transform(orca_context):
+    rng = np.random.RandomState(0)
+    feats = [list(v) for v in rng.randn(64, 4).astype(np.float32)]
+    labels = [float(sum(f)) for f in feats]
+    df = pd.DataFrame({"features": feats, "label": labels})
+    est = (NNEstimator(_MLP(out=1), "mean_squared_error")
+           .setBatchSize(16).setMaxEpoch(3).setLearningRate(0.01))
+    model = est.fit(df)
+    assert isinstance(model, NNModel)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+    assert len(out) == 64
+
+
+def test_nnclassifier_argmax(orca_context):
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int32)
+    df = pd.DataFrame({"features": [list(v) for v in x], "label": y})
+    clf = (NNClassifier(_MLP(out=2, softmax=True))
+           .setBatchSize(16).setMaxEpoch(5).setLearningRate(0.05))
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"].to_numpy() == y).mean()
+    assert acc > 0.6
+    assert out["prediction"].dtype == np.int64
+
+
+def test_tensorboard_writer_roundtrip(tmp_path):
+    from analytics_zoo_tpu.utils.tensorboard import (FileWriter, crc32c,
+                                                     read_scalars)
+    # crc32c known-answer test (rfc 3720 vector)
+    assert crc32c(b"123456789") == 0xE3069283
+    d = str(tmp_path / "tb")
+    w = FileWriter(d)
+    for i in range(5):
+        w.add_scalar("Loss", 1.0 / (i + 1), i)
+    w.add_scalar("Throughput", 1000.0, 4)
+    w.close()
+    scalars = read_scalars(d)
+    assert [s for s, _ in scalars["Loss"]] == [0, 1, 2, 3, 4]
+    assert scalars["Loss"][0][1] == pytest.approx(1.0)
+    assert scalars["Throughput"] == [(4, 1000.0)]
+
+
+def test_estimator_tensorboard_integration(orca_context, tmp_path):
+    from analytics_zoo_tpu.orca.learn.estimator import Estimator
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = rng.randn(32, 1).astype(np.float32)
+    est = Estimator.from_keras(model=_MLP(out=1), loss="mean_squared_error")
+    est.set_tensorboard(str(tmp_path), "app")
+    est.fit({"x": x, "y": y}, epochs=2, batch_size=16, verbose=False,
+            validation_data={"x": x, "y": y})
+    train = est.get_train_summary("Loss")
+    assert len(train) == 4            # 2 epochs x 2 steps
+    val = est.get_validation_summary("loss")
+    assert len(val) == 2

@@ -1,0 +1,107 @@
+"""Friesian feature tables (reference tests:
+pyzoo/test/zoo/friesian/feature/test_table.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.friesian import FeatureTable, StringIndex
+
+
+def _tbl():
+    return FeatureTable.from_pandas(pd.DataFrame({
+        "user": ["a", "b", "a", "c", "b", "a"],
+        "item": [1, 2, 3, 1, 2, 2],
+        "price": [1.0, np.nan, 3.0, 4.0, np.nan, 6.0],
+        "time": [1, 2, 3, 4, 5, 6],
+    }))
+
+
+def test_fillna_dropna_clip_log():
+    t = _tbl()
+    assert t.fillna(0.0, "price").df["price"].isna().sum() == 0
+    assert len(t.dropna("price")) == 4
+    clipped = t.clip("item", min=2).df["item"]
+    assert clipped.min() == 2
+    logged = t.fillna(0, "price").log("price").df["price"]
+    assert np.allclose(logged[0], np.log(2.0))
+
+
+def test_fill_median_and_median():
+    t = _tbl()
+    med = t.median("price")
+    assert med.iloc[0]["median"] == pytest.approx(3.5)
+    filled = t.fill_median("price")
+    assert filled.df["price"].isna().sum() == 0
+
+
+def test_gen_string_idx_and_encode():
+    t = _tbl()
+    (idx,) = t.gen_string_idx("user")
+    assert isinstance(idx, StringIndex)
+    mapping = idx.to_mapping()
+    assert mapping["a"] == 1          # most frequent gets id 1
+    enc = t.encode_string("user", idx)
+    assert enc.df["user"].tolist()[0] == 1
+    # freq_limit drops rare categories -> encoded as 0
+    (idx2,) = t.gen_string_idx("user", freq_limit=2)
+    enc2 = t.encode_string("user", idx2)
+    assert (enc2.df["user"] == 0).sum() == 1  # "c" dropped
+
+
+def test_cross_columns_and_normalize():
+    t = _tbl()
+    crossed = t.cross_columns([["user", "item"]], [100])
+    assert "user_item" in crossed.df.columns
+    assert crossed.df["user_item"].between(0, 99).all()
+    norm = t.normalize("time")
+    assert norm.df["time"].min() == 0.0 and norm.df["time"].max() == 1.0
+
+
+def test_negative_sampling():
+    t = FeatureTable.from_pandas(pd.DataFrame({
+        "user": [1, 2], "item": [3, 4]}))
+    out = t.add_negative_samples(item_size=10, neg_num=2)
+    assert len(out) == 6
+    assert (out.df["label"] == 0).sum() == 4
+    negs = out.df[out.df["label"] == 0]
+    # negatives never equal the positive item of their row
+    assert (negs["item"].to_numpy() !=
+            np.repeat([3, 4], 2)).all()
+
+
+def test_hist_seq_pad_mask():
+    t = _tbl()
+    h = t.add_hist_seq("user", "item", sort_col="time", min_len=1, max_len=2)
+    assert "item_hist_seq" in h.df.columns
+    a_rows = h.df[h.df["user"] == "a"]
+    assert a_rows.iloc[0]["item_hist_seq"] == [1]
+    padded = h.pad("item_hist_seq", seq_len=4)
+    assert all(len(s) == 4 for s in padded.df["item_hist_seq"])
+    masked = h.mask("item_hist_seq", seq_len=4)
+    assert masked.df["item_hist_seq_mask"].iloc[0] == [1, 0, 0, 0]
+    withlen = h.add_length("item_hist_seq")
+    assert withlen.df["item_hist_seq_length"].iloc[0] == 1
+
+
+def test_join_and_add_feature():
+    t = _tbl()
+    cat = FeatureTable.from_pandas(pd.DataFrame(
+        {"item": [1, 2, 3], "category": ["x", "y", "z"]}))
+    out = t.add_feature("item", cat, default_value="unk")
+    assert out.df["item_category"].tolist()[0] == "x"
+    joined = t.join(cat, on="item")
+    assert "category" in joined.df.columns
+
+
+def test_parquet_roundtrip(tmp_path):
+    t = _tbl().fillna(0, "price")
+    p = str(tmp_path / "t.parquet")
+    t.write_parquet(p)
+    back = FeatureTable.read_parquet(p)
+    assert len(back) == len(t)
+
+
+def test_to_shards():
+    shards = _tbl().to_shards(num_shards=2)
+    assert shards.num_partitions() == 2

@@ -71,6 +71,22 @@ def test_recurrent_layers():
     assert _init_apply(TimeDistributed(Dense(7)), x).shape == (2, 5, 7)
 
 
+def test_go_backwards_returns_full_scan_state():
+    """Regression: reverse + keep_order puts the final state at index 0 —
+    a backward RNN must return the whole-sequence encoding, not the state
+    after one step."""
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 5, 3)
+                    .astype(np.float32))
+    fwd = LSTM(4)
+    v = fwd.init(jax.random.PRNGKey(0), x)
+    ref_final = fwd.apply(v, x[:, ::-1])      # forward over reversed input
+
+    bwd = LSTM(4, go_backwards=True)
+    out = bwd.apply(v, x)                      # same params, same structure
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_final),
+                               atol=1e-6)
+
+
 def test_misc_layers():
     x = jnp.ones((2, 4, 6))
     assert _init_apply(Permute((2, 1)), x).shape == (2, 6, 4)

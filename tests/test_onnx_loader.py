@@ -1,0 +1,209 @@
+"""ONNX loader: wire-format parsing + node execution vs torch reference
+(reference tests: pyzoo/test/zoo/pipeline/api/onnx/).
+
+No onnx package in this image, so the test hand-encodes ModelProto wire
+format — which doubles as a spec-level check of the parser."""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+from analytics_zoo_tpu.pipeline.api.onnx.onnx_loader import (ONNXModule,
+                                                             load, parse_onnx)
+from analytics_zoo_tpu.utils.protostream import varint
+
+
+def _tag(field, wire):
+    return varint((field << 3) | wire)
+
+
+def _ld(field, payload: bytes) -> bytes:
+    return _tag(field, 2) + varint(len(payload)) + payload
+
+
+def _s(field, text: str) -> bytes:
+    return _ld(field, text.encode())
+
+
+def _i(field, v: int) -> bytes:
+    return _tag(field, 0) + varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    out = b"".join(_i(1, d) for d in arr.shape)
+    out += _i(2, 1)  # float
+    out += _s(8, name)
+    out += _ld(9, arr.astype("<f4").tobytes())
+    return out
+
+
+def _attr_ints(name: str, ints) -> bytes:
+    body = _s(1, name) + b"".join(_i(8, v) for v in ints)
+    return body
+
+
+def _attr_int(name: str, v: int) -> bytes:
+    return _s(1, name) + _i(3, v)
+
+
+def _attr_float(name: str, v: float) -> bytes:
+    return _s(1, name) + _tag(2, 5) + struct.pack("<f", v)
+
+
+def _node(op, inputs, outputs, attrs=()) -> bytes:
+    out = b"".join(_s(1, i) for i in inputs)
+    out += b"".join(_s(2, o) for o in outputs)
+    out += _s(4, op)
+    out += b"".join(_ld(5, a) for a in attrs)
+    return out
+
+
+def _vinfo(name: str, shape) -> bytes:
+    dims = b"".join(_ld(1, _i(1, d)) for d in shape)
+    tshape = _ld(2, dims)
+    ttype = _ld(1, _i(1, 1) + tshape)
+    return _s(1, name) + _ld(2, ttype)
+
+
+def _model(nodes, initializers, inputs, outputs) -> bytes:
+    graph = b"".join(_ld(1, n) for n in nodes)
+    graph += _s(2, "g")
+    graph += b"".join(_ld(5, t) for t in initializers)
+    graph += b"".join(_ld(11, v) for v in inputs)
+    graph += b"".join(_ld(12, _vinfo(o, [1])) for o in outputs)
+    return _ld(7, graph)
+
+
+def test_parse_and_run_mlp_matches_torch():
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(8, 4).astype(np.float32)   # Gemm transB weights (out,in)
+    b1 = rng.randn(8).astype(np.float32)
+    x = rng.randn(2, 4).astype(np.float32)
+
+    model_bytes = _model(
+        nodes=[
+            _node("Gemm", ["x", "w", "b"], ["h"],
+                  attrs=[_attr_int("transB", 1)]),
+            _node("Relu", ["h"], ["hr"]),
+            _node("Softmax", ["hr"], ["y"], attrs=[_attr_int("axis", 1)]),
+        ],
+        initializers=[_tensor("w", w1), _tensor("b", b1)],
+        inputs=[_vinfo("x", [2, 4])],
+        outputs=["y"],
+    )
+    g = parse_onnx(model_bytes)
+    assert [n.op_type for n in g.nodes] == ["Gemm", "Relu", "Softmax"]
+    assert g.inputs[0][0] == "x"
+    mod = load(model_bytes)
+    v = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out = np.asarray(mod.apply(v, jnp.asarray(x)))
+
+    tm = tnn.Linear(4, 8)
+    with torch.no_grad():
+        tm.weight.copy_(torch.tensor(w1))
+        tm.bias.copy_(torch.tensor(b1))
+        ref = torch.softmax(torch.relu(tm(torch.tensor(x))), dim=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv_pool_graph_matches_torch():
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    x = rng.randn(1, 3, 8, 8).astype(np.float32)
+
+    model_bytes = _model(
+        nodes=[
+            _node("Conv", ["x", "w", "b"], ["c"], attrs=[
+                _attr_ints("kernel_shape", [3, 3]),
+                _attr_ints("strides", [1, 1]),
+                _attr_ints("pads", [1, 1, 1, 1])]),
+            _node("Relu", ["c"], ["cr"]),
+            _node("MaxPool", ["cr"], ["p"], attrs=[
+                _attr_ints("kernel_shape", [2, 2]),
+                _attr_ints("strides", [2, 2])]),
+            _node("GlobalAveragePool", ["p"], ["gap"]),
+            _node("Flatten", ["gap"], ["y"], attrs=[_attr_int("axis", 1)]),
+        ],
+        initializers=[_tensor("w", w), _tensor("b", b)],
+        inputs=[_vinfo("x", [1, 3, 8, 8])],
+        outputs=["y"],
+    )
+    mod = load(model_bytes)
+    v = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out = np.asarray(mod.apply(v, jnp.asarray(x)))
+
+    conv = tnn.Conv2d(3, 4, 3, padding=1)
+    with torch.no_grad():
+        conv.weight.copy_(torch.tensor(w))
+        conv.bias.copy_(torch.tensor(b))
+        t = torch.relu(conv(torch.tensor(x)))
+        t = tnn.functional.max_pool2d(t, 2)
+        ref = t.mean(dim=(2, 3)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_elementwise_and_bn():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 4, 4).astype(np.float32) + 0.1
+    scale = rng.rand(3).astype(np.float32)
+    bias = rng.rand(3).astype(np.float32)
+    mean = rng.rand(3).astype(np.float32)
+    var = rng.rand(3).astype(np.float32) + 0.5
+
+    model_bytes = _model(
+        nodes=[
+            _node("BatchNormalization",
+                  ["x", "scale", "bias", "mean", "var"], ["bn"],
+                  attrs=[_attr_float("epsilon", 1e-5)]),
+            _node("Sigmoid", ["bn"], ["y"]),
+        ],
+        initializers=[_tensor("scale", scale), _tensor("bias", bias),
+                      _tensor("mean", mean), _tensor("var", var)],
+        inputs=[_vinfo("x", [2, 3, 4, 4])],
+        outputs=["y"],
+    )
+    mod = load(model_bytes, trainable=False)
+    v = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out = np.asarray(mod.apply(v, jnp.asarray(x)))
+    bn = tnn.BatchNorm2d(3)
+    with torch.no_grad():
+        bn.weight.copy_(torch.tensor(scale))
+        bn.bias.copy_(torch.tensor(bias))
+        bn.running_mean.copy_(torch.tensor(mean))
+        bn.running_var.copy_(torch.tensor(var))
+        bn.eval()
+        ref = torch.sigmoid(bn(torch.tensor(x))).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_loaded_model_is_finetunable():
+    rng = np.random.RandomState(3)
+    w = rng.randn(2, 4).astype(np.float32)
+    model_bytes = _model(
+        nodes=[_node("Gemm", ["x", "w"], ["y"],
+                     attrs=[_attr_int("transB", 1)])],
+        initializers=[_tensor("w", w)],
+        inputs=[_vinfo("x", [2, 4])],
+        outputs=["y"],
+    )
+    mod = load(model_bytes, trainable=True)
+    x = jnp.ones((2, 4))
+    v = mod.init(jax.random.PRNGKey(0), x)
+    grads = jax.grad(lambda p: jnp.sum(mod.apply(p, x) ** 2))(v)
+    assert any(np.abs(np.asarray(g)).sum() > 0
+               for g in jax.tree.leaves(grads))
+
+
+def test_unsupported_op_raises():
+    model_bytes = _model(
+        nodes=[_node("FancyCustomOp", ["x"], ["y"])],
+        initializers=[], inputs=[_vinfo("x", [1])], outputs=["y"])
+    mod = load(model_bytes)
+    with pytest.raises(NotImplementedError, match="FancyCustomOp"):
+        mod.init(jax.random.PRNGKey(0), jnp.ones((1,)))
