@@ -1,0 +1,1 @@
+from .auto_xgb import AutoXGBClassifier, AutoXGBRegressor

@@ -1,0 +1,138 @@
+"""AutoXGBoost (parity: pyzoo/zoo/orca/automl/xgboost/auto_xgb.py —
+AutoXGBRegressor/AutoXGBClassifier over the search engine).
+
+xgboost is not baked into the TPU image; when it is importable these classes
+run real HPO over xgboost models with the same chip-pinned search engine the
+flax models use, otherwise construction raises with install guidance."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _require_xgboost():
+    try:
+        import xgboost
+        return xgboost
+    except ImportError as e:
+        raise ImportError(
+            "AutoXGBoost needs the 'xgboost' package, which is not part of "
+            "the TPU image. pip install xgboost (CPU training) to use it; "
+            "tree models do not run on the TPU compute path.") from e
+
+
+class _XGBModelBuilder:
+    def __init__(self, model_cls, fixed: Dict[str, Any]):
+        self.model_cls = model_cls
+        self.fixed = fixed
+
+    def build(self, config: Dict[str, Any]):
+        params = dict(self.fixed)
+        params.update(config)
+        return self.model_cls(**params)
+
+
+class _AutoXGB:
+    _objective = None
+    _metric_default = None
+
+    def __init__(self, cpus_per_trial: int = 1, name: str = "auto_xgb",
+                 remote_dir: Optional[str] = None, logs_dir: str = "/tmp",
+                 **xgb_configs):
+        self.xgb = _require_xgboost()
+        self.fixed = dict(xgb_configs)
+        self.name = name
+        self.best_model = None
+        self.best_config = None
+
+    def _model_cls(self):
+        raise NotImplementedError
+
+    def fit(self, data, validation_data=None, metric: Optional[str] = None,
+            metric_mode: str = "min", search_space: Optional[dict] = None,
+            n_sampling: int = 4, search_alg=None, epochs: int = 1, **_):
+        from ..search.search_engine import TPUSearchEngine
+        from .. import hp
+
+        x, y = data
+        vx, vy = validation_data if validation_data is not None else (x, y)
+        metric = metric or self._metric_default
+        search_space = search_space or {
+            "n_estimators": hp.randint(50, 300),
+            "max_depth": hp.randint(2, 10),
+            "lr": hp.loguniform(1e-3, 0.3),
+        }
+        builder = _XGBModelBuilder(self._model_cls(), self.fixed)
+        score_of = self._score
+
+        class _TrialModel:
+            """fit_eval contract of TPUSearchEngine.compile (tree training
+            runs on host CPU; the trial scheduler is shared)."""
+
+            def __init__(self, config, mesh):
+                cfg = dict(config)
+                if "lr" in cfg:
+                    cfg["learning_rate"] = cfg.pop("lr")
+                cfg.pop("batch_size", None)
+                self.model = builder.build(cfg)
+
+            def fit_eval(self, train, val, epochs=1, metric=metric):
+                tx, ty = train
+                vx_, vy_ = val
+                self.model.fit(tx, ty)
+                score = score_of(vy_, self.model.predict(vx_), metric)
+                return score, {metric: score}, self.model
+
+        engine = TPUSearchEngine(name=self.name)
+        engine.compile((x, y), _TrialModel, search_space,
+                       n_sampling=n_sampling, epochs=epochs,
+                       validation_data=(vx, vy), metric=metric,
+                       metric_mode=metric_mode)
+        engine.run()
+        best = engine.get_best_trial()
+        self.best_config = best.config
+        self.best_model = best.model_state
+        return self
+
+    @staticmethod
+    def _score(y_true, y_pred, metric: str) -> float:
+        y_true = np.asarray(y_true)
+        y_pred = np.asarray(y_pred)
+        if metric in ("mae",):
+            return float(np.mean(np.abs(y_true - y_pred)))
+        if metric in ("mse", "rmse"):
+            mse = float(np.mean((y_true - y_pred) ** 2))
+            return mse ** 0.5 if metric == "rmse" else mse
+        if metric in ("error", "accuracy"):
+            acc = float(np.mean(y_true == y_pred))
+            return 1 - acc if metric == "error" else acc
+        if metric == "logloss":
+            p = np.clip(y_pred, 1e-7, 1 - 1e-7)
+            return float(-np.mean(y_true * np.log(p) +
+                                  (1 - y_true) * np.log(1 - p)))
+        raise ValueError(f"unknown metric {metric!r}")
+
+    def predict(self, x):
+        return self.best_model.predict(x)
+
+    def get_best_model(self):
+        return self.best_model
+
+    def get_best_config(self):
+        return self.best_config
+
+
+class AutoXGBRegressor(_AutoXGB):
+    _metric_default = "rmse"
+
+    def _model_cls(self):
+        return self.xgb.XGBRegressor
+
+
+class AutoXGBClassifier(_AutoXGB):
+    _metric_default = "error"
+
+    def _model_cls(self):
+        return self.xgb.XGBClassifier

@@ -1,0 +1,3 @@
+from .infeed import InfeedPump
+from .runtime import (Arena, NativeQueue, available, f32_to_bf16_bits,
+                      gather_rows, pad_sequences, shuffled_indices, version)

@@ -1,0 +1,66 @@
+"""Device infeed pump: double-buffered host→HBM pipeline.
+
+The reference hides infeed latency with per-executor JVM threads pulling from
+Spark block manager (SURVEY.md §3.2); on TPU the equivalent is: a background
+host thread assembles the next batch (native gather/pad, no GIL) and calls
+``jax.device_put`` while the current step runs, so the chip never waits on the
+host (SURVEY.md §7 hard part #1)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+
+from .runtime import NativeQueue
+
+_STOP = object()
+
+
+class InfeedPump:
+    """Wrap a host-batch iterator factory; yields device-resident batches one
+    step ahead of consumption."""
+
+    def __init__(self, batch_iter_factory: Callable[[], Iterator],
+                 device_put: Optional[Callable] = None, depth: int = 2):
+        self._factory = batch_iter_factory
+        self._device_put = device_put or jax.device_put
+        self._depth = depth
+
+    def __iter__(self):
+        q = NativeQueue(capacity=self._depth)
+        err = []
+
+        def producer():
+            try:
+                for batch in self._factory():
+                    if not q.put(self._device_put(batch)):
+                        return          # consumer closed the queue: stop
+            except Exception as e:          # surface on the consumer side
+                err.append(e)
+            finally:
+                q.put(_STOP, timeout_ms=100)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="zoo-infeed-pump")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _STOP or item is None:
+                    break
+                yield item
+        finally:
+            q.close()                   # unblocks the producer's put()
+            t.join(timeout=30)
+            if t.is_alive():
+                # never free the native queue under a live producer; leaking
+                # one queue beats a use-after-free
+                import logging
+                logging.getLogger("analytics_zoo_tpu").warning(
+                    "infeed producer did not stop; leaking its queue")
+            else:
+                q.destroy()
+        if err:
+            raise err[0]

@@ -1,0 +1,2 @@
+from .parquet_dataset import (ParquetDataset, SchemaField, write_from_directory,
+                              write_mnist, write_ndarrays, write_voc)

@@ -70,7 +70,8 @@ class TPUEstimator:
 
     def __init__(self, module, loss=None, optimizer="adam", metrics=None,
                  model_dir: Optional[str] = None,
-                 config: Optional[dict] = None, seed: int = 0, mesh=None):
+                 config: Optional[dict] = None, seed: int = 0, mesh=None,
+                 fsdp: bool = False):
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.module = module
@@ -80,7 +81,7 @@ class TPUEstimator:
         self.metrics = convert_metrics_list(metrics)
         tx = convert_optimizer(optimizer)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
-                                  self.mesh, seed=seed)
+                                  self.mesh, seed=seed, fsdp_params=fsdp)
         self._trainer_state = TrainerState()
         self.train_stats: List[Dict[str, float]] = []
         self._tb_train = None
@@ -126,7 +127,7 @@ class TPUEstimator:
         it = learn_utils.data_to_iterator(
             data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=shuffle, config=self.config)
-        sample = next(it.epoch(shuffle=False))
+        sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         checkpoint_trigger = (Trigger.convert_trigger(checkpoint_trigger)
                               if checkpoint_trigger else None)
@@ -193,7 +194,7 @@ class TPUEstimator:
         it = learn_utils.data_to_iterator(
             data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=False, config=self.config)
-        sample = next(it.epoch(shuffle=False))
+        sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         states = self.engine.init_metric_states()
         loss_sum, count = 0.0, 0.0

@@ -104,7 +104,7 @@ class PyTorchTPUEstimator(TPUEstimator):
             it = learn_utils.data_to_iterator(
                 data, batch_size, self.mesh, config=self.config,
                 **it_kwargs)
-            sample = next(it.epoch(shuffle=False))
+            sample = next(it.epoch(shuffle=False, prefetch=False))
             self.engine.build(tuple(np.asarray(a) for a in sample.x))
             if self._param_loader is not None:
                 self._load_torch_weights()
@@ -135,7 +135,7 @@ class PyTorchTPUEstimator(TPUEstimator):
             from .. import utils as learn_utils
             it = learn_utils.data_to_iterator(data, batch_size, self.mesh,
                                               config=self.config)
-            sample = next(it.epoch(shuffle=False))
+            sample = next(it.epoch(shuffle=False, prefetch=False))
             self.engine.build(tuple(np.asarray(a) for a in sample.x))
             self._load_torch_weights()
         return super().evaluate(data, batch_size=batch_size, **kwargs)

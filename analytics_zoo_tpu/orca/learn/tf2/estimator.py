@@ -81,7 +81,7 @@ class TF2TPUEstimator(TPUEstimator):
             it = learn_utils.data_to_iterator(data, batch_size, self.mesh,
                                               feature_cols, label_cols,
                                               config=self.config)
-            sample = next(it.epoch(shuffle=False))
+            sample = next(it.epoch(shuffle=False, prefetch=False))
             self.engine.build(tuple(np.asarray(a) for a in sample.x))
         else:
             merged = learn_utils.concat_shards(shards)

@@ -90,9 +90,21 @@ def normalize_xshards(shards: HostXShards, feature_cols=None,
         return out
 
     def from_dict(d):
-        out = {"x": _as_tuple(d["x"])}
-        if "y" in d and d["y"] is not None:
-            out["y"] = _as_tuple(d["y"])
+        if "x" in d:
+            out = {"x": _as_tuple(d["x"])}
+            if "y" in d and d["y"] is not None:
+                out["y"] = _as_tuple(d["y"])
+            return out
+        # column-keyed dict shards (e.g. ParquetDataset.read_as_xshards):
+        # feature_cols/label_cols select the tensors, like the reference's
+        # dataframe-to-shard path
+        if not feature_cols:
+            raise ValueError(
+                "shards are column dicts; pass feature_cols (and label_cols)"
+                f" — available keys: {sorted(d.keys())}")
+        out = {"x": tuple(np.asarray(d[c]) for c in feature_cols)}
+        if label_cols:
+            out["y"] = tuple(np.asarray(d[c]) for c in label_cols)
         return out
 
     try:
@@ -177,13 +189,18 @@ class BatchIterator:
             return jax.make_array_from_process_local_data(sh, arr)
         return jax.device_put(arr, sh)
 
-    def epoch(self, shuffle: Optional[bool] = None) -> Iterator[Batch]:
-        shuffle = self.shuffle if shuffle is None else shuffle
-        order = np.arange(self.n)
+    def _host_batches(self, shuffle: bool) -> Iterator[Batch]:
+        """Assemble host-side batches: native shuffled index generation and
+        threaded row-gather (analytics_zoo_tpu.native), both off the GIL."""
+        from analytics_zoo_tpu.native import gather_rows, shuffled_indices
         if shuffle:
-            rng = np.random.RandomState(self.seed + self._epoch)
-            rng.shuffle(order)
+            order = shuffled_indices(self.n, seed=self.seed + self._epoch)
+        else:
+            order = np.arange(self.n, dtype=np.int64)
         self._epoch += 1
+        xs_src = tuple(np.asarray(a) for a in self.x)
+        ys_src = (tuple(np.asarray(a) for a in self.y)
+                  if self.y is not None else None)
         for s in range(self.steps_per_epoch):
             idx = order[s * self.local_bs:(s + 1) * self.local_bs]
             real = len(idx)
@@ -192,10 +209,31 @@ class BatchIterator:
                     [idx, np.zeros(self.local_bs - real, dtype=idx.dtype)])
             w = np.zeros(self.local_bs, dtype=np.float32)
             w[:real] = 1.0
-            xs = tuple(self._device_put(np.asarray(a)[idx]) for a in self.x)
-            ys = (tuple(self._device_put(np.asarray(a)[idx]) for a in self.y)
-                  if self.y is not None else None)
-            yield Batch(x=xs, y=ys, w=self._device_put(w))
+            xs = tuple(gather_rows(a, idx) for a in xs_src)
+            ys = (tuple(gather_rows(a, idx) for a in ys_src)
+                  if ys_src is not None else None)
+            yield Batch(x=xs, y=ys, w=w)
+
+    def _put_batch(self, b: Batch) -> Batch:
+        return Batch(
+            x=tuple(self._device_put(a) for a in b.x),
+            y=(tuple(self._device_put(a) for a in b.y)
+               if b.y is not None else None),
+            w=self._device_put(b.w))
+
+    def epoch(self, shuffle: Optional[bool] = None,
+              prefetch: bool = True) -> Iterator[Batch]:
+        """Yield device-resident batches. With prefetch, a background pump
+        stages the next batch into HBM while the current step runs
+        (SURVEY.md §7 hard part #1 — infeed throughput)."""
+        shuffle = self.shuffle if shuffle is None else shuffle
+        if not prefetch:
+            for b in self._host_batches(shuffle):
+                yield self._put_batch(b)
+            return
+        from analytics_zoo_tpu.native.infeed import InfeedPump
+        yield from InfeedPump(lambda: self._host_batches(shuffle),
+                              device_put=self._put_batch, depth=2)
 
 
 def data_to_iterator(data: Any, batch_size: int, mesh: Mesh,

@@ -1,0 +1,107 @@
+"""Low-level pipeline Estimator (parity: pyzoo/zoo/pipeline/estimator/
+estimator.py:22 — train:127/train_minibatch/evaluate over a model +
+OptimMethod; Scala pipeline/estimator/Estimator.scala:68,141).
+
+The TPU engine's minibatch loop is already the whole optimizer, so this class
+is the thin imperative surface: construct from a model + optim method, call
+train_minibatch on your own loop or train() on a dataset."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ...orca.learn.engine import TrainEngine
+from ...orca.learn.losses import convert_loss
+from ...orca.learn.metrics import convert_metrics_list
+from ...orca.learn.optimizers.optimizers_impl import convert_optimizer
+from ...orca.learn.utils import Batch
+from ...common.context import get_context
+
+
+class Estimator:
+    def __init__(self, model, optim_methods=None, model_dir: Optional[str] = None):
+        self.ctx = get_context()
+        self.model = model
+        self.optim = convert_optimizer(optim_methods or "sgd")
+        self.model_dir = model_dir
+        self._engine: Optional[TrainEngine] = None
+        self._loss = None
+
+    def _engine_for(self, loss, metrics=None) -> TrainEngine:
+        loss_fn = convert_loss(loss) if loss is not None else None
+        if self._engine is None or self._loss is not loss:
+            self._engine = TrainEngine(
+                self.model, self.optim, loss_fn,
+                convert_metrics_list(metrics), self.ctx.mesh)
+            self._loss = loss
+        return self._engine
+
+    def train_minibatch(self, x, y, loss="mean_squared_error"):
+        """One optimization step on one minibatch (reference
+        train_minibatch)."""
+        eng = self._engine_for(loss)
+        x = (x,) if not isinstance(x, (tuple, list)) else tuple(x)
+        y = (y,) if not isinstance(y, (tuple, list)) else tuple(y)
+        if eng.params is None:
+            eng.build(tuple(np.asarray(a) for a in x))
+        import jax.numpy as jnp
+        w = jnp.ones(np.asarray(x[0]).shape[0], jnp.float32)
+        loss_val = eng.train_batch(Batch(
+            x=tuple(jnp.asarray(a) for a in x),
+            y=tuple(jnp.asarray(a) for a in y), w=w))
+        return float(loss_val)
+
+    def train(self, train_set: Iterable, criterion="mean_squared_error",
+              end_trigger=None, checkpoint_trigger=None,
+              validation_set=None, validation_method=None,
+              batch_size: int = 32, epochs: int = 1) -> List[float]:
+        """train_set: iterable of (x, y) minibatches or a {'x','y'} dict."""
+        losses = []
+        if isinstance(train_set, dict):
+            from ...orca.learn.estimator import TPUEstimator
+            est = TPUEstimator(self.model, loss=criterion,
+                               optimizer=self.optim)
+            stats = est.fit(train_set, epochs=epochs, batch_size=batch_size,
+                            verbose=False)
+            self._engine = est.engine
+            return [s["train_loss"] for s in stats]
+        for _ in range(epochs):
+            for x, y in train_set:
+                losses.append(self.train_minibatch(x, y, loss=criterion))
+        return losses
+
+    def evaluate(self, validation_set, validation_method=None,
+                 batch_size: int = 32) -> Dict[str, float]:
+        from ...orca.learn.estimator import TPUEstimator
+        est = TPUEstimator(self.model, loss=self._loss or
+                           "mean_squared_error",
+                           optimizer=self.optim,
+                           metrics=validation_method)
+        if self._engine is not None:
+            est.engine = self._engine
+        return est.evaluate(validation_set, batch_size=batch_size,
+                            verbose=False)
+
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float):
+        """reference Estimator.setConstantGradientClipping."""
+        import optax
+        self.optim = optax.chain(
+            optax.clip(max(abs(min_value), abs(max_value))), self.optim)
+        self._engine = None
+        return self
+
+    def set_l2_norm_gradient_clipping(self, clip_norm: float):
+        import optax
+        self.optim = optax.chain(optax.clip_by_global_norm(clip_norm),
+                                 self.optim)
+        self._engine = None
+        return self
+
+    def clear_gradient_clipping(self):
+        # rebuild without the clipping chain on next use
+        raise NotImplementedError(
+            "construct a fresh Estimator to clear clipping (optax chains "
+            "are immutable)")

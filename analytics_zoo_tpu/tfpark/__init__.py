@@ -1,0 +1,146 @@
+"""tfpark migration-compat namespace (reference: pyzoo/zoo/tfpark/ — 4465 LoC
+of TF1-on-Spark machinery: TFDataset families, TFOptimizer, TFNet,
+KerasModel, TFEstimator, GANEstimator).
+
+On TPU the entire export-graph/py4j/DistriOptimizer pipeline collapses into
+the one jitted engine, so this package is a thin compatibility facade: the
+TFDataset constructors land in XShards/BatchIterator forms, KerasModel wraps
+the flax estimator, and GANEstimator is the real implementation re-exported
+from orca.learn. TF1 graph-mode entry points (TFOptimizer.from_loss, TFNet)
+raise with a pointer to their TPU-native replacement rather than silently
+half-working."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..orca.learn.gan_estimator import GANEstimator  # noqa: F401
+
+
+class TFDataset:
+    """Constructor surface of tfpark.TFDataset (reference tf_dataset.py:117).
+    Holds {'x','y'} host arrays; estimators consume it like any dict."""
+
+    def __init__(self, x, y=None, batch_size: int = -1,
+                 batch_per_thread: int = -1, **_):
+        self.x = x
+        self.y = y
+        self.batch_size = batch_size if batch_size > 0 else None
+
+    # --- reference constructors (tf_dataset.py:324-637) ---------------------
+    @classmethod
+    def from_ndarrays(cls, tensors, batch_size: int = -1,
+                      batch_per_thread: int = -1, val_tensors=None, **kw):
+        if isinstance(tensors, (list, tuple)) and len(tensors) == 2:
+            return cls(tensors[0], tensors[1], batch_size, batch_per_thread)
+        return cls(tensors, None, batch_size, batch_per_thread)
+
+    @classmethod
+    def from_rdd(cls, rdd, **kwargs):
+        raise NotImplementedError(
+            "Spark RDDs do not exist in the TPU runtime; load data with "
+            "orca.data (XShards / read_csv / read_parquet) instead")
+
+    @classmethod
+    def from_feature_set(cls, dataset, **kwargs):
+        raise NotImplementedError(
+            "use orca.data XShards in place of FeatureSet on TPU")
+
+    @classmethod
+    def from_dataframe(cls, df, feature_cols, labels_cols=None, **kwargs):
+        x = np.stack([np.asarray(v) for v in
+                      df[feature_cols].to_numpy()]).astype(np.float32)
+        y = (df[labels_cols].to_numpy() if labels_cols else None)
+        return cls(x, y, kwargs.get("batch_size", -1))
+
+    @classmethod
+    def from_tf_data_dataset(cls, dataset, batch_size: int = -1, **kwargs):
+        """Materialise a (finite) tf.data.Dataset to host arrays."""
+        import tensorflow as tf  # noqa: F401
+        xs, ys = [], []
+        for item in dataset.as_numpy_iterator():
+            if isinstance(item, tuple) and len(item) == 2:
+                xs.append(item[0])
+                ys.append(item[1])
+            else:
+                xs.append(item)
+        x = np.stack(xs)
+        y = np.stack(ys) if ys else None
+        return cls(x, y, batch_size)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"x": self.x} if self.y is None else {"x": self.x,
+                                                     "y": self.y}
+
+
+class KerasModel:
+    """reference tfpark/model.py:30 KerasModel(tf.keras model) — here it
+    wraps either our pipeline Keras net or any flax module."""
+
+    def __init__(self, model, loss="mean_squared_error", optimizer="adam",
+                 metrics=None):
+        from ..pipeline.api.keras.engine.topology import KerasNet
+        if isinstance(model, KerasNet):
+            model.compile(optimizer=optimizer, loss=loss, metrics=metrics)
+            self._est = model.estimator
+        else:
+            from ..orca.learn.estimator import TPUEstimator
+            self._est = TPUEstimator(model, loss=loss, optimizer=optimizer,
+                                     metrics=metrics)
+
+    def fit(self, x, y=None, batch_size=32, epochs=1, distributed=True,
+            **kwargs):
+        data = x.to_dict() if isinstance(x, TFDataset) else (
+            {"x": x, "y": y} if y is not None else x)
+        bs = getattr(x, "batch_size", None) or batch_size
+        return self._est.fit(data, epochs=epochs, batch_size=bs, **kwargs)
+
+    def evaluate(self, x, y=None, batch_per_thread=32, distributed=True):
+        data = x.to_dict() if isinstance(x, TFDataset) else (
+            {"x": x, "y": y} if y is not None else x)
+        return self._est.evaluate(data, batch_size=batch_per_thread)
+
+    def predict(self, x, batch_per_thread=32, distributed=True):
+        data = ({"x": x.x} if isinstance(x, TFDataset)
+                else ({"x": x} if not isinstance(x, dict) else x))
+        return self._est.predict(data, batch_size=batch_per_thread)
+
+    def save_weights(self, path):
+        self._est.save(path)
+
+    def load_weights(self, path):
+        self._est.load(path)
+
+
+class TFOptimizer:
+    @classmethod
+    def from_loss(cls, *args, **kwargs):
+        raise NotImplementedError(
+            "TF1 graph export is not part of the TPU stack: write the model "
+            "as a flax module (or keras pipeline net) and use "
+            "orca.learn.Estimator.from_keras — the loss/grad/allreduce "
+            "pipeline is one jitted XLA program (SURVEY.md §3.2)")
+
+    from_keras = from_loss
+    from_train_op = from_loss
+
+
+class TFNet:
+    @classmethod
+    def from_export_folder(cls, *args, **kwargs):
+        raise NotImplementedError(
+            "TF graph inference runs through "
+            "pipeline.inference.InferenceModel (load_tf) on TPU")
+
+    from_session = from_export_folder
+
+
+class TFEstimator:
+    """reference tfpark/estimator.py:30 model_fn-style estimator."""
+
+    def __init__(self, model_fn: Callable, *args, **kwargs):
+        raise NotImplementedError(
+            "model_fn-style TF estimators are replaced by "
+            "orca.learn.Estimator.from_keras(model_creator) on TPU")

@@ -1,5 +1,6 @@
 from .model.forecast import (Forecaster, LSTMForecaster, MTNetForecaster,
                              Seq2SeqForecaster, TCNForecaster)
+from .model.tcmf import TCMF, TCMFForecaster
 
 __all__ = ["Forecaster", "LSTMForecaster", "TCNForecaster",
-           "Seq2SeqForecaster", "MTNetForecaster"]
+           "Seq2SeqForecaster", "MTNetForecaster", "TCMF", "TCMFForecaster"]

@@ -1,7 +1,8 @@
 from .forecast import (Forecaster, LSTMForecaster, MTNetForecaster,
                        Seq2SeqForecaster, TCNForecaster)
+from .tcmf import TCMF, TCMFForecaster
 from .anomaly import AEDetector, DBScanDetector, ThresholdDetector
 
-__all__ = ["Forecaster", "LSTMForecaster", "TCNForecaster",
+__all__ = ["TCMF", "TCMFForecaster", "Forecaster", "LSTMForecaster", "TCNForecaster",
            "Seq2SeqForecaster", "MTNetForecaster", "ThresholdDetector",
            "AEDetector", "DBScanDetector"]

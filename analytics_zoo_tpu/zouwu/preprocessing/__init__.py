@@ -1,0 +1,3 @@
+from .impute import (BaseImputation, FillZeroImpute, LastFill,
+                     LastFillImpute, LinearImpute, MeanImpute,
+                     TimeMergeImputor)

@@ -1,0 +1,217 @@
+"""Parquet image datasets, TCMF, 3D transforms, GANEstimator, low-level
+pipeline Estimator, tfpark compat facade, FSDP engine already in test_fsdp."""
+
+import os
+import struct
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.orca.data.image import (ParquetDataset, SchemaField,
+                                               write_mnist, write_ndarrays)
+
+
+def test_parquet_dataset_roundtrip(tmp_path):
+    imgs = np.random.RandomState(0).randint(
+        0, 255, (25, 4, 4, 1)).astype(np.uint8)
+    labels = (np.arange(25) % 3).astype(np.int64)
+    path = str(tmp_path / "ds")
+    write_ndarrays(imgs, labels, path, block_size=10)
+    shards = ParquetDataset.read_as_xshards(path)
+    assert shards.num_partitions() == 3
+    parts = shards.collect()
+    assert parts[0]["image"].shape == (10, 4, 4, 1)
+    all_labels = np.concatenate([p["label"] for p in parts])
+    np.testing.assert_array_equal(all_labels, labels)
+    ds = ParquetDataset.read_as_torch(path)
+    assert len(ds) == 25 and ds[3]["image"].shape == (4, 4, 1)
+
+
+def test_write_mnist_idx_format(tmp_path):
+    img_f = str(tmp_path / "imgs.idx")
+    lab_f = str(tmp_path / "labs.idx")
+    with open(img_f, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 3, 3))
+        f.write(bytes(range(45)))
+    with open(lab_f, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5))
+        f.write(bytes([0, 1, 2, 1, 0]))
+    out = str(tmp_path / "mnist")
+    write_mnist(img_f, lab_f, out)
+    parts = ParquetDataset.read_as_xshards(out).collect()
+    assert parts[0]["image"].shape == (5, 3, 3, 1)
+    assert parts[0]["label"].tolist() == [0, 1, 2, 1, 0]
+
+
+def test_tcmf_fit_predict_save_load(tmp_path):
+    from analytics_zoo_tpu.zouwu.model.tcmf import TCMFForecaster
+    rng = np.random.RandomState(0)
+    t = np.arange(120)
+    y = (np.sin(2 * np.pi * t / 12)[None] * rng.rand(10, 1) +
+         rng.randn(10, 120) * 0.05 + 1.0).astype(np.float32)
+    fc = TCMFForecaster(rank=4, num_channels_X=(8, 8), kernel_size=3)
+    stats = fc.fit({"y": y[:, :108]}, epochs=200)
+    assert np.isfinite(stats["train_loss"])
+    pred = fc.predict(horizon=12)
+    assert pred.shape == (10, 12)
+    assert np.isfinite(pred).all()
+    # bounded: rollout must not diverge
+    assert np.abs(pred).max() < 10 * np.abs(y).max()
+    p = str(tmp_path / "tcmf.pkl")
+    fc.save(p)
+    fc2 = TCMFForecaster.load(p)
+    np.testing.assert_allclose(fc2.predict(12), pred, rtol=1e-5)
+    (mae,) = fc.evaluate(y[:, 108:], ["mae"])
+    assert np.isfinite(mae)
+    inc = fc.fit({"y": y[:, 108:]}, incremental=True)
+    assert np.isfinite(inc["train_loss"])
+
+
+def test_image3d_transforms():
+    from analytics_zoo_tpu.feature.image3d import (AffineTransform3D,
+                                                   CenterCrop3D, Crop3D,
+                                                   RandomCrop3D, Rotate3D)
+    v = np.random.RandomState(0).rand(12, 12, 12).astype(np.float32)
+    assert Crop3D((1, 1, 1), (6, 6, 6)).transform(v).shape == (6, 6, 6)
+    assert CenterCrop3D(4, 4, 4).transform(v).shape == (4, 4, 4)
+    assert RandomCrop3D(4, 4, 4, seed=1).transform(v).shape == (4, 4, 4)
+    ident = Rotate3D([0, 0, 0]).transform(v)
+    np.testing.assert_allclose(ident, v, atol=1e-6)
+    rot = Rotate3D([np.pi / 4, 0, 0]).transform(v)
+    assert rot.shape == v.shape and not np.allclose(rot, v)
+    aff = AffineTransform3D(np.eye(3), translation=np.array([1.0, 0, 0]))
+    shifted = aff.transform(v)
+    np.testing.assert_allclose(shifted[1:-1, 2:-2, 2:-2],
+                               v[:-2, 2:-2, 2:-2], atol=1e-4)
+
+
+class _G(nn.Module):
+    @nn.compact
+    def __call__(self, z):
+        return nn.Dense(2)(nn.relu(nn.Dense(16)(z)))
+
+
+class _D(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(nn.relu(nn.Dense(16)(x)))
+
+
+def test_gan_estimator_trains(orca_context):
+    from analytics_zoo_tpu.orca.learn.gan_estimator import GANEstimator
+    rng = np.random.RandomState(0)
+    real = (rng.randn(128, 2) * 0.3 + np.array([2.0, -1.0])
+            ).astype(np.float32)
+    gan = GANEstimator(_G(), _D(), noise_dim=4)
+    stats = gan.train({"x": real}, epochs=20, batch_size=64, verbose=False)
+    assert np.isfinite(stats[-1]["g_loss"])
+    before = np.linalg.norm(real.mean(0))
+    samples = gan.generate(256)
+    assert samples.shape == (256, 2)
+    # generator should have moved toward the data mean
+    assert np.linalg.norm(samples.mean(0) - real.mean(0)) < before
+
+
+def test_gan_wasserstein_loss():
+    from analytics_zoo_tpu.orca.learn.gan_estimator import gan_loss_fns
+    import jax.numpy as jnp
+    g, d = gan_loss_fns("wasserstein")
+    fake = jnp.asarray([1.0, -1.0])
+    real = jnp.asarray([2.0, 0.0])
+    assert float(g(fake)) == pytest.approx(0.0)
+    assert float(d(real, fake)) == pytest.approx(-1.0)
+
+
+def test_pipeline_estimator_minibatch_loop(orca_context):
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(nn.relu(nn.Dense(8)(x)))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x @ rng.randn(4, 1)).astype(np.float32)
+    est = Estimator(MLP(), optim_methods="adam")
+    first = est.train_minibatch(x[:32], y[:32])
+    for _ in range(20):
+        last = est.train_minibatch(x[:32], y[:32])
+    assert last < first
+    est2 = Estimator(MLP(), optim_methods="sgd")
+    est2.set_l2_norm_gradient_clipping(1.0)
+    losses = est2.train({"x": x, "y": y}, epochs=2, batch_size=32)
+    assert len(losses) == 2 and np.isfinite(losses[-1])
+
+
+def test_tfpark_compat_facade(orca_context):
+    from analytics_zoo_tpu.tfpark import (KerasModel, TFDataset, TFNet,
+                                          TFOptimizer)
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 6).astype(np.float32)
+    y = (x.sum(-1, keepdims=True)).astype(np.float32)
+    ds = TFDataset.from_ndarrays((x, y), batch_size=32)
+    m = KerasModel(Sequential([Dense(8, activation="relu"), Dense(1)]),
+                   loss="mean_squared_error")
+    stats = m.fit(ds, epochs=2, verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+    preds = m.predict(x[:4])
+    assert np.asarray(preds).shape == (4, 1)
+    with pytest.raises(NotImplementedError, match="flax"):
+        TFOptimizer.from_loss(None, None)
+    with pytest.raises(NotImplementedError, match="InferenceModel"):
+        TFNet.from_export_folder("/tmp/x")
+    with pytest.raises(NotImplementedError):
+        TFDataset.from_rdd(None)
+
+
+def test_tfpark_from_dataframe(orca_context):
+    df = pd.DataFrame({"f": [[1.0, 2.0], [3.0, 4.0]], "l": [1.0, 2.0]})
+    from analytics_zoo_tpu.tfpark import TFDataset
+    ds = TFDataset.from_dataframe(df, feature_cols="f", labels_cols="l")
+    assert ds.x.shape == (2, 2)
+
+
+def test_zouwu_impute():
+    from analytics_zoo_tpu.zouwu.preprocessing import (FillZeroImpute,
+                                                       LastFillImpute,
+                                                       LinearImpute,
+                                                       TimeMergeImputor)
+    df = pd.DataFrame({"v": [np.nan, 1.0, np.nan, 3.0, np.nan]})
+    assert LastFillImpute().impute(df)["v"].tolist() == [1, 1, 1, 3, 3]
+    assert FillZeroImpute().impute(df)["v"].tolist() == [0, 1, 0, 3, 0]
+    assert LinearImpute().impute(df)["v"].tolist() == [1, 1, 2, 3, 3]
+    tdf = pd.DataFrame({
+        "ts": pd.to_datetime(["2020-01-01 00:00:00", "2020-01-01 00:00:30",
+                              "2020-01-01 00:02:00"]),
+        "v": [1.0, 3.0, 5.0]})
+    out = TimeMergeImputor(60, "ts", "mean").impute(tdf)
+    assert out["v"].tolist() == [2.0, 2.0, 5.0]   # merged + gap filled
+    mse = LastFillImpute().evaluate(
+        pd.DataFrame({"v": np.sin(np.arange(100) / 5.0)}), drop_rate=0.2)
+    assert mse < 0.2
+
+
+def test_auto_xgb_gated_without_xgboost():
+    from analytics_zoo_tpu.automl.xgboost import AutoXGBRegressor
+    try:
+        import xgboost  # noqa: F401
+        has_xgb = True
+    except ImportError:
+        has_xgb = False
+    if has_xgb:
+        reg = AutoXGBRegressor()
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4)
+        y = x.sum(-1)
+        reg.fit((x, y), n_sampling=2)
+        assert reg.predict(x).shape == (64,)
+    else:
+        with pytest.raises(ImportError, match="xgboost"):
+            AutoXGBRegressor()

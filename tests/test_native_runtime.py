@@ -1,0 +1,121 @@
+"""Native C++ host runtime: allocator, queue, shuffle, batch assembly,
+infeed pump (counterpart of the reference's JNI layer — pmem allocator,
+MTSampleToMiniBatch)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.native import (Arena, InfeedPump, NativeQueue,
+                                      available, f32_to_bf16_bits,
+                                      gather_rows, pad_sequences,
+                                      shuffled_indices, version)
+
+
+def test_native_library_builds():
+    assert available(), "g++ is in the image; the native lib must build"
+    assert "native" in version()
+
+
+def test_arena_alloc_reset():
+    a = Arena(1 << 16)
+    x = a.alloc_array((8, 8), np.float32)
+    x[:] = 3.0
+    assert a.used >= 8 * 8 * 4
+    y = a.alloc_array((4,), np.int64)
+    y[:] = 7
+    assert x.sum() == 192.0          # distinct buffers
+    a.reset()
+    assert a.used == 0
+    with pytest.raises(MemoryError):
+        Arena(1 << 16).alloc_array((1 << 20,), np.float64)
+    a.close()
+
+
+def test_shuffled_indices_deterministic_permutation():
+    a = shuffled_indices(1000, seed=42)
+    b = shuffled_indices(1000, seed=42)
+    c = shuffled_indices(1000, seed=43)
+    assert (a == b).all()
+    assert not (a == c).all()
+    assert sorted(a.tolist()) == list(range(1000))
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.RandomState(0)
+    src = rng.randn(512, 17).astype(np.float32)
+    idx = rng.randint(0, 512, 2048).astype(np.int64)
+    np.testing.assert_array_equal(gather_rows(src, idx), src[idx])
+    # multi-dim rows
+    src3 = rng.randn(64, 4, 5).astype(np.float32)
+    np.testing.assert_array_equal(gather_rows(src3, idx % 64),
+                                  src3[idx % 64])
+
+
+def test_pad_sequences_semantics():
+    out, mask = pad_sequences([[1, 2, 3, 4, 5], [9], []], max_len=3)
+    assert out.tolist() == [[1, 2, 3], [9, 0, 0], [0, 0, 0]]
+    assert mask.tolist() == [[1, 1, 1], [1, 0, 0], [0, 0, 0]]
+    out2 = pad_sequences([[7]], max_len=2, pad_value=-1, return_mask=False)
+    assert out2.tolist() == [[7, -1]]
+
+
+def test_bf16_conversion_matches_jax():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    x = rng.randn(1000).astype(np.float32) * 100
+    ours = f32_to_bf16_bits(x)
+    ref = np.asarray(jnp.asarray(x).astype(jnp.bfloat16)).view(np.uint16)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_native_queue_fifo_and_close():
+    q = NativeQueue(capacity=2)
+    assert q.put("a") and q.put("b")
+    assert not q.put("c", timeout_ms=50)      # full
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.get(timeout_ms=50) is None       # empty
+    q.close()
+    q.destroy()
+
+
+def test_native_queue_threads():
+    import threading
+    q = NativeQueue(capacity=4)
+    got = []
+
+    def consumer():
+        while True:
+            item = q.get()
+            if item is None or item == "stop":
+                break
+            got.append(item)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(100):
+        q.put(i)
+    q.put("stop")
+    t.join(timeout=10)
+    assert got == list(range(100))
+    q.destroy()
+
+
+def test_infeed_pump_prefetches_in_order():
+    batches = [np.full((2, 2), i, np.float32) for i in range(10)]
+
+    def factory():
+        return iter(batches)
+
+    seen = [np.asarray(b)[0, 0] for b in InfeedPump(factory, depth=3)]
+    assert seen == list(range(10))
+
+
+def test_infeed_pump_propagates_errors():
+    def factory():
+        yield np.ones(2)
+        raise RuntimeError("loader exploded")
+
+    pump = InfeedPump(factory)
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        list(pump)
