@@ -40,7 +40,12 @@ class InfeedPump:
             except Exception as e:          # surface on the consumer side
                 err.append(e)
             finally:
-                q.put(_STOP, timeout_ms=100)
+                # Blocking put: the sentinel must never be dropped, or the
+                # consumer hangs forever in q.get() at epoch end. If the
+                # queue is full (consumer stuck in a long first-step jit
+                # compile) this waits for a slot; the consumer's finally
+                # q.close() unblocks the wait when iteration is abandoned.
+                q.put(_STOP, timeout_ms=-1)
 
         t = threading.Thread(target=producer, daemon=True,
                              name="zoo-infeed-pump")

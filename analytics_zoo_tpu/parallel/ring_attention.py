@@ -52,11 +52,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # Accumulators must carry the inputs' varying-axes type (jax >= 0.9
     # shard_map vma typing) or the scan carry is rejected; pvary marks the
     # device-invariant zeros as varying over every manual axis in scope.
-    vma = tuple(getattr(jax.typeof(q), "vma", ()) |
+    vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) |
                 getattr(jax.typeof(k), "vma", frozenset()))
-    acc = lax.pvary(jnp.zeros((b, s_local, h, d), jnp.float32), vma)
-    m = lax.pvary(jnp.full((b, s_local, h), -jnp.inf, jnp.float32), vma)
-    l = lax.pvary(jnp.zeros((b, s_local, h), jnp.float32), vma)
+    if hasattr(lax, "pcast"):
+        def _vary(x):
+            return lax.pcast(x, vma, to="varying")
+    else:                                   # jax < pcast introduction
+        def _vary(x):
+            return lax.pvary(x, vma)
+    acc = _vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    m = _vary(jnp.full((b, s_local, h), -jnp.inf, jnp.float32))
+    l = _vary(jnp.zeros((b, s_local, h), jnp.float32))
 
     def step(carry, i):
         k_blk, v_blk, acc, m, l = carry

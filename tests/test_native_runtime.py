@@ -119,3 +119,41 @@ def test_infeed_pump_propagates_errors():
     pump = InfeedPump(factory)
     with pytest.raises(RuntimeError, match="loader exploded"):
         list(pump)
+
+
+def test_infeed_pump_slow_consumer_gets_sentinel():
+    """Regression: the _STOP sentinel must survive a full queue.
+
+    With depth=2 and a consumer that stalls on the first item (simulating the
+    first-step jit compile), the producer finishes all puts while both slots
+    are full; a timed sentinel put used to be dropped silently, leaving the
+    consumer blocked forever in q.get(). The pump must deliver every batch
+    AND terminate."""
+    import time
+    batches = [np.full((2,), i, np.float32) for i in range(3)]
+
+    def factory():
+        return iter(batches)
+
+    seen = []
+    for b in InfeedPump(factory, depth=2):
+        if not seen:
+            time.sleep(0.5)     # producer fills + exhausts iterator meanwhile
+        seen.append(float(np.asarray(b)[0]))
+    assert seen == [0.0, 1.0, 2.0]
+
+
+def test_infeed_pump_abandoned_consumer_does_not_hang(caplog):
+    """Breaking out of iteration mid-stream must unblock the producer's
+    blocking sentinel put via q.close()."""
+    import logging
+    def factory():
+        return iter(np.full((2,), i, np.float32) for i in range(50))
+
+    it = iter(InfeedPump(factory, depth=2))
+    next(it)
+    with caplog.at_level(logging.WARNING, logger="analytics_zoo_tpu"):
+        it.close()               # generator finally: q.close() + join
+    # if close() stopped unblocking the producer, the pump would fall back
+    # to the 30s join timeout and log this leak warning
+    assert "infeed producer did not stop" not in caplog.text
