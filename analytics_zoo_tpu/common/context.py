@@ -121,7 +121,7 @@ def init_orca_context(cluster_mode: str = "local",
         _setup_logging(cfg.log_level)
 
         if cluster_mode in ("tpu", "multihost") and (
-                num_processes or 1) > 1 or coordinator_address:
+                (num_processes or 1) > 1 or coordinator_address):
             # multi-host: every host runs this same program (SPMD controller).
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

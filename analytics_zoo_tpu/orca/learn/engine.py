@@ -80,6 +80,41 @@ class TrainEngine:
         self._jit_train = None
         self._jit_eval = None
         self._jit_predict = None
+        self._clip_norm: Optional[float] = None
+        self._clip_min: Optional[float] = None
+        self._clip_max: Optional[float] = None
+
+    # --- gradient clipping (reference plumbs clip-by-L2 / clip-constant
+    # through every estimator: zoo/.../pipeline/estimator/Estimator.scala:
+    # 68-141) — applied to grads inside the jitted step, so clipping config
+    # never changes the optax state structure ---------------------------------
+    _KEEP = object()                    # "leave this clip setting as-is"
+
+    def set_gradient_clipping(self, *, norm=_KEEP, min_value=_KEEP,
+                              max_value=_KEEP):
+        """Update clip settings; unspecified kwargs keep their current value
+        (so norm- and constant-clipping can be configured independently)."""
+        if norm is not TrainEngine._KEEP:
+            self._clip_norm = norm
+        if min_value is not TrainEngine._KEEP:
+            self._clip_min = min_value
+        if max_value is not TrainEngine._KEEP:
+            self._clip_max = max_value
+        self._jit_train = None          # clip constants are baked into the jit
+
+    def clear_gradient_clipping(self):
+        self.set_gradient_clipping(norm=None, min_value=None, max_value=None)
+
+    def _clip_grads(self, grads):
+        if self._clip_norm is not None:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, self._clip_norm /
+                                jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        if self._clip_min is not None or self._clip_max is not None:
+            grads = jax.tree.map(
+                lambda g: jnp.clip(g, self._clip_min, self._clip_max), grads)
+        return grads
 
     # --- init ---------------------------------------------------------------
     def build(self, sample_x: Tuple[np.ndarray, ...]):
@@ -173,6 +208,7 @@ class TrainEngine:
 
         (loss, (_, new_extra)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        grads = self._clip_grads(grads)
         updates, new_opt = self.tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_extra, new_opt, loss

@@ -87,6 +87,23 @@ class TPUEstimator:
         self._tb_train = None
         self._tb_val = None
 
+    # --- gradient clipping (reference: orca/learn/tf/estimator.py
+    # set_constant_gradient_clipping / set_l2_norm_gradient_clipping,
+    # Estimator.scala:68-141) ------------------------------------------------
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float):
+        self.engine.set_gradient_clipping(min_value=min_value,
+                                          max_value=max_value)
+        return self
+
+    def set_l2_norm_gradient_clipping(self, clip_norm: float):
+        self.engine.set_gradient_clipping(norm=clip_norm)
+        return self
+
+    def clear_gradient_clipping(self):
+        self.engine.clear_gradient_clipping()
+        return self
+
     # --- tensorboard (reference: orca/learn/tf/estimator.py:167-220,
     # pipeline/estimator/Estimator.scala:116-122) ----------------------------
     def set_tensorboard(self, log_dir: str, app_name: str):
@@ -119,11 +136,23 @@ class TPUEstimator:
             checkpoint_trigger: Optional[Trigger] = None,
             steps_per_epoch: Optional[int] = None,
             shuffle: bool = True, verbose: bool = True,
-            callbacks=None) -> List[Dict[str, float]]:
+            callbacks=None, profile=False,
+            max_failure_retries: Optional[int] = None
+            ) -> List[Dict[str, float]]:
         """Train. Accepts dict-of-ndarray {'x','y'}, (x, y) tuples, XShards
         (dict or pandas shards + feature/label cols), or a data_creator
         callable — same surface as the reference estimators' fit
-        (orca/learn/tf2/estimator.py:166-263)."""
+        (orca/learn/tf2/estimator.py:166-263).
+
+        ``profile`` — True collects per-step data-wait / step-execution
+        timings into the epoch stats (the Ray torch runner's ``profile=True``,
+        reference torch_runner.py:360); a directory path additionally wraps
+        the first epoch in a ``jax.profiler`` trace.
+
+        ``max_failure_retries`` — when ``model_dir`` is set, a failing
+        training step is retried from the latest checkpoint up to this many
+        times (default 5), matching the reference's retry-from-snapshot loop
+        in InternalDistriOptimizer (Topology.scala:1256-1337)."""
         it = learn_utils.data_to_iterator(
             data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=shuffle, config=self.config)
@@ -131,40 +160,43 @@ class TPUEstimator:
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         checkpoint_trigger = (Trigger.convert_trigger(checkpoint_trigger)
                               if checkpoint_trigger else None)
+        # recovery is opted into by checkpointing (a trigger) or an explicit
+        # retry count; a bare model_dir (often set just to control save()
+        # paths) must not start writing ckpt-* directories on its own
+        opted_in = (checkpoint_trigger is not None
+                    or max_failure_retries is not None
+                    or "max_failure_retries" in self.config)
+        retries_left = (self.config.get("max_failure_retries", 5)
+                        if max_failure_retries is None
+                        else max_failure_retries)
+        can_recover = (self.model_dir is not None and retries_left > 0
+                       and opted_in)
+        if can_recover and \
+                learn_utils.find_latest_checkpoint(self.model_dir)[0] is None:
+            # guarantee a restore point exists before the first step
+            self.save_checkpoint(self.model_dir)
 
         epoch_stats = []
-        for ep in range(epochs):
-            t0 = time.time()
-            losses = []
-            tb_steps = []
-            nsteps = steps_per_epoch or it.steps_per_epoch
-            for i, batch in enumerate(it.epoch()):
-                if i >= nsteps:
-                    break
-                loss = self.engine.train_batch(batch)
-                losses.append(loss)
-                self._trainer_state.iteration += 1
-                if self._tb_train is not None:
-                    # keep the device array; flush with ONE device_get at
-                    # epoch end so logging never blocks async dispatch
-                    tb_steps.append(self._trainer_state.iteration)
-                if checkpoint_trigger and self.model_dir:
-                    self._trainer_state.epoch_finished = False
-                    if checkpoint_trigger(self._trainer_state):
-                        self.save_checkpoint(self.model_dir)
-            host_losses = jax.device_get(losses)
-            if self._tb_train is not None:
-                for step, lv in zip(tb_steps, host_losses):
-                    self._tb_train.add_scalar("Loss", float(lv), step)
-                self._tb_train.flush()
-            mean_loss = float(np.mean(host_losses))
-            self._trainer_state.epoch += 1
-            self._trainer_state.epoch_finished = True
-            self._trainer_state.loss = mean_loss
-            dt = time.time() - t0
-            stats = {"epoch": ep + 1, "train_loss": mean_loss,
-                     "num_samples": len(it.x[0]) if hasattr(it, "x") else None,
-                     "time_s": round(dt, 3)}
+        ep = 0
+        while ep < epochs:
+            try:
+                stats = self._fit_epoch(it, ep, steps_per_epoch,
+                                        checkpoint_trigger, profile)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if not can_recover or retries_left <= 0:
+                    raise
+                retries_left -= 1
+                path, step = learn_utils.find_latest_checkpoint(
+                    self.model_dir)
+                logger.warning(
+                    "training failed at epoch %d (%s: %s); restoring "
+                    "checkpoint %s and retrying (%d retries left)",
+                    ep + 1, type(e).__name__, e, path, retries_left)
+                self.load_checkpoint(self.model_dir)
+                self._trainer_state.iteration = self.engine.step
+                continue                 # re-run the failed epoch
             if validation_data is not None:
                 val = self.evaluate(validation_data, batch_size=batch_size,
                                     feature_cols=feature_cols,
@@ -183,8 +215,69 @@ class TPUEstimator:
             if verbose:
                 logger.info("epoch %d: %s", ep + 1, stats)
             epoch_stats.append(stats)
+            ep += 1
         self.train_stats.extend(epoch_stats)
         return epoch_stats
+
+    def _fit_epoch(self, it, ep: int, steps_per_epoch: Optional[int],
+                   checkpoint_trigger, profile) -> Dict[str, float]:
+        """One epoch of the hot loop; raises through to fit()'s retry."""
+        t0 = time.time()
+        losses = []
+        tb_steps = []
+        nsteps = steps_per_epoch or it.steps_per_epoch
+        prof = {"data_s": 0.0, "step_s": 0.0} if profile else None
+        tracing = isinstance(profile, str) and ep == 0
+        if tracing:
+            jax.profiler.start_trace(profile)
+        try:
+            batches = iter(it.epoch())
+            for i in range(nsteps):
+                if prof is not None:
+                    td = time.perf_counter()
+                batch = next(batches, None)
+                if batch is None:
+                    break
+                if prof is not None:
+                    ts = time.perf_counter()
+                    prof["data_s"] += ts - td
+                loss = self.engine.train_batch(batch)
+                if prof is not None:
+                    jax.block_until_ready(loss)
+                    prof["step_s"] += time.perf_counter() - ts
+                losses.append(loss)
+                self._trainer_state.iteration += 1
+                if self._tb_train is not None:
+                    # keep the device array; flush with ONE device_get at
+                    # epoch end so logging never blocks async dispatch
+                    tb_steps.append(self._trainer_state.iteration)
+                if checkpoint_trigger and self.model_dir:
+                    self._trainer_state.epoch_finished = False
+                    if checkpoint_trigger(self._trainer_state):
+                        self.save_checkpoint(self.model_dir)
+        finally:
+            if tracing:
+                jax.profiler.stop_trace()
+        host_losses = jax.device_get(losses)
+        if self._tb_train is not None:
+            for step, lv in zip(tb_steps, host_losses):
+                self._tb_train.add_scalar("Loss", float(lv), step)
+            self._tb_train.flush()
+        mean_loss = float(np.mean(host_losses))
+        self._trainer_state.epoch += 1
+        self._trainer_state.epoch_finished = True
+        self._trainer_state.loss = mean_loss
+        dt = time.time() - t0
+        stats = {"epoch": ep + 1, "train_loss": mean_loss,
+                 "num_samples": len(it.x[0]) if hasattr(it, "x") else None,
+                 "time_s": round(dt, 3)}
+        if prof is not None:
+            n = max(len(host_losses), 1)
+            stats["profile"] = {
+                "mean_data_s": prof["data_s"] / n,
+                "mean_step_s": prof["step_s"] / n,
+                "steps": len(host_losses)}
+        return stats
 
     # --- evaluate -----------------------------------------------------------
     def evaluate(self, data, batch_size: int = 32, feature_cols=None,
@@ -197,13 +290,18 @@ class TPUEstimator:
         sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         states = self.engine.init_metric_states()
-        loss_sum, count = 0.0, 0.0
+        # accumulate device scalars; ONE device_get at the end so eval keeps
+        # async dispatch going (fit() already works this way)
+        losses, counts = [], []
         for i, batch in enumerate(it.epoch(shuffle=False)):
             if num_steps is not None and i >= num_steps:
                 break
             states, batch_loss, n = self.engine.eval_batch(states, batch)
-            loss_sum += float(jax.device_get(batch_loss))
-            count += float(jax.device_get(n))
+            losses.append(batch_loss)
+            counts.append(n)
+        host_losses, host_counts = jax.device_get((losses, counts))
+        loss_sum = float(np.sum(host_losses))
+        count = float(np.sum(host_counts))
         result = self.engine.finalize_metrics(states, loss_sum, count)
         if verbose:
             logger.info("validation: %s", result)

@@ -205,5 +205,11 @@ def convert_optimizer(opt, learning_rate: float = None
                 if name in params:
                     kwargs[name] = learning_rate
                     break
+            else:
+                raise ValueError(
+                    f"optimizer '{opt}' takes no learning-rate parameter; "
+                    f"the explicit learning_rate={learning_rate} would be "
+                    f"silently ignored — construct {table[key].__name__}(...) "
+                    "directly instead")
         return table[key](**kwargs).to_optax()
     raise ValueError(f"cannot convert {opt!r} to an optimizer")

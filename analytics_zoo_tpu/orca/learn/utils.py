@@ -12,6 +12,7 @@ dynamic (SURVEY.md §7 hard-part #2).
 
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -22,6 +23,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...utils import nest
 from ..data.shard import HostXShards
+
+logger = logging.getLogger("analytics_zoo_tpu")
 
 
 @dataclass
@@ -165,6 +168,11 @@ class BatchIterator:
         if self.local_bs % local_div:
             self.local_bs = math.ceil(self.local_bs / local_div) * local_div
         self.global_bs = self.local_bs * max(nproc, 1)
+        if self.global_bs != batch_size:
+            logger.warning(
+                "batch_size %d is not divisible by the %d-way data axes; "
+                "training with effective global batch %d",
+                batch_size, data_axis, self.global_bs)
         self.shuffle = shuffle
         self.seed = seed
         self.pad_tail = pad_tail

@@ -39,7 +39,7 @@ class NNEstimator:
         self._batch_size = 32
         self._max_epoch = 10
         self._optim_method = "adam"
-        self._learning_rate = 1e-3
+        self._learning_rate = None      # None = optimizer's own default
         self._caching_sample = True
 
     # --- Spark-ML style setters (reference NNEstimator setters) -------------
@@ -84,7 +84,9 @@ class NNEstimator:
     def _make_estimator(self):
         from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
         opt = self._optim_method
-        if isinstance(opt, str) and self._learning_rate:
+        if isinstance(opt, str) and self._learning_rate is not None:
+            # only an explicit setLearningRate overrides; lr-less optimizers
+            # (e.g. adadelta) keep working with their own defaults
             from analytics_zoo_tpu.orca.learn.optimizers.optimizers_impl \
                 import convert_optimizer
             opt = convert_optimizer(opt, learning_rate=self._learning_rate)

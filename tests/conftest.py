@@ -28,9 +28,15 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
-@pytest.fixture(scope="package")
+@pytest.fixture()
 def orca_context():
-    from analytics_zoo_tpu import init_orca_context, stop_orca_context
-    ctx = init_orca_context("cpu-sim", mesh_axes={"dp": -1})
-    yield ctx
-    stop_orca_context()
+    # function-scoped but idempotent: reuse the live context when one exists
+    # (quietly — init_orca_context would warn), rebuild only after a test
+    # (e.g. the fsdp-mesh suite) stopped it. atexit stops the last one.
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.common import context as ctx_mod
+    live = ctx_mod._current
+    if live is not None and not live._stopped:
+        yield live
+    else:
+        yield init_orca_context("cpu-sim", mesh_axes={"dp": -1})
