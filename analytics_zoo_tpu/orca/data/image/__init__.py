@@ -1,2 +1,4 @@
 from .parquet_dataset import (ParquetDataset, SchemaField, write_from_directory,
                               write_mnist, write_ndarrays, write_voc)
+from .imagenet import (IMAGENET_MEAN, IMAGENET_STD, ImageNetPipeline,
+                       write_synthetic_imagenet)

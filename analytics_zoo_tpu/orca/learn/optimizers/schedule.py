@@ -110,10 +110,16 @@ class SequentialSchedule(Scheduler):
         if not self._entries:
             return optax.constant_schedule(base_lr)
         schedules, boundaries, acc = [], [], 0
+        current = base_lr
         for sched, n in self._entries:
             if isinstance(sched, Warmup) and sched.steps is None:
                 sched = Warmup(sched.delta, n)
-            schedules.append(sched.to_optax(base_lr))
+            schedules.append(sched.to_optax(current))
+            if isinstance(sched, Warmup):
+                # a Warmup's end point becomes the next schedule's base, so
+                # Warmup->Poly reproduces the classic ramp-to-peak-then-decay
+                # recipe (reference resnet-50-imagenet.py:382-386)
+                current = current + sched.delta * (sched.steps or n)
             acc += n
             boundaries.append(acc)
         return optax.join_schedules(schedules, boundaries[:-1])

@@ -249,6 +249,9 @@ def data_to_iterator(data: Any, batch_size: int, mesh: Mesh,
                      seed: int = 0, pad_tail: bool = True,
                      config: Optional[dict] = None) -> BatchIterator:
     """Front door: any supported data form -> BatchIterator."""
+    if hasattr(data, "epoch") and hasattr(data, "steps_per_epoch"):
+        return data                 # already a batch iterator (duck-typed),
+        # e.g. orca.data.image.imagenet.ImageNetPipeline streaming from disk
     if callable(data):  # data_creator(config, batch_size) like tf2/pytorch est.
         produced = data(config or {}, batch_size)
         return data_to_iterator(produced, batch_size, mesh, feature_cols,

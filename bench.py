@@ -1,50 +1,204 @@
 #!/usr/bin/env python
-"""Benchmark: NCF-MovieLens training throughput on TPU (BASELINE config #1).
+"""Benchmark: ResNet-50 ImageNet + NCF-MovieLens training throughput on TPU.
 
-Trains the flagship NeuralCF model (MovieLens-1M scale: 6040 users, 3706
-items, reference app apps/recommendation-ncf/ncf-explicit-feedback.ipynb) with
-the unified Orca estimator engine and reports steady-state training
-samples/sec on the attached chip.
+Primary metric (the BASELINE.md north star): ResNet-50 ImageNet training
+samples/sec/chip measured END-TO-END — synthetic uint8 image shards on disk,
+memory-mapped host crop/flip assembly, batches fed through the input pipeline
+into the jitted train step every measured step (reference workload config:
+pyzoo/zoo/examples/orca/learn/tf2/resnet/resnet-50-imagenet.py:26-33,351).
 
-Baseline: the reference publishes no absolute numbers (BASELINE.md); the
-north-star target is >=0.8x Horovod-on-8xA100 per-chip throughput. MLPerf-era
-NCF runs reach ~60M samples/sec on a DGX-1 (8xV100); scaling ~2x for A100
-gives ~120M/8 = 15M samples/sec/chip as the comparison constant.
+Also reported (extras in the same JSON line + BENCH_DETAIL.json):
+  - compute-only samples/sec/chip (device-resident batches) and MFU from the
+    XLA-compiled step's own cost analysis vs the chip's peak bf16 rate;
+  - the measured host->device transfer rate with live training state, which
+    on the tunneled dev chip collapses to ~50 MB/s (vs ~1.4 GB/s idle) and is
+    the binding constraint on the e2e number. On a real TPU host PCIe/DMA
+    does not degrade this way, so e2e there approaches the compute rate.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Measurement notes for this environment:
+  - async dispatch makes `block_until_ready` unreliable for timing over the
+    tunnel; every measured section ends with a value fetch (float(loss)),
+    which forces completion of the whole dependency chain.
+  - background-thread device_put (the InfeedPump default, correct on real
+    hosts) serializes pathologically against queued compute here, so the
+    bench feeds the jit directly from the main thread (implicit transfer),
+    which measured fastest end-to-end of all patterns tried.
+
+Baselines: the reference publishes no absolute numbers (BASELINE.md); target
+is >=0.8x Horovod-on-8xA100 per-chip throughput. Constants:
+  - ResNet-50: MLPerf-era A100 ~2900 img/s/GPU -> 2900.0 samples/sec/chip.
+  - NCF: ~60M samples/sec on 8xV100, ~2x for A100 -> 15M samples/sec/chip.
+
+Prints ONE JSON line {"metric","value","unit","vs_baseline", ...extras} and
+writes per-workload detail to BENCH_DETAIL.json.
 """
 
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-BASELINE_SAMPLES_PER_SEC_PER_CHIP = 15_000_000.0
+RESNET_BASELINE = 2900.0        # A100 img/s, see module docstring
+NCF_BASELINE = 15_000_000.0
+
+# peak dense bf16 FLOP/s per jax device (public TPU specs; v2/v3 devices are
+# cores, v4+ devices are chips). Longest key wins so "v5p" beats "v5".
+_PEAK_BF16 = {"v6": 918e12, "v5p": 459e12, "v5": 197e12, "v4": 275e12,
+              "v3": 61.5e12, "v2": 23e12}
+_PEAK_ORDER = sorted(_PEAK_BF16.items(), key=lambda kv: -len(kv[0]))
 
 
-def main():
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_ORDER:
+        if key in kind:
+            return val
+    return 0.0
+
+
+def _step_flops(jitted, args, fallback: float) -> float:
+    """FLOPs of one compiled step from XLA's own cost analysis."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else fallback
+    except Exception:
+        return fallback
+
+
+def bench_resnet50(smoke: bool) -> dict:
     import jax
-    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.common.context import get_context
+    from analytics_zoo_tpu.models.image.resnet import resnet
+    from analytics_zoo_tpu.orca.data.image import (ImageNetPipeline,
+                                                   write_synthetic_imagenet)
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+    from analytics_zoo_tpu.orca.learn.optimizers import SGD
+    from analytics_zoo_tpu.orca.learn.optimizers.schedule import (
+        Poly, SequentialSchedule, Warmup)
+
+    ctx = get_context()
+    if smoke:
+        batch, num_images, image_size, crop, steps, depth = \
+            64, 256, 72, 64, 6, 18
+    else:
+        batch, num_images, image_size, crop, steps, depth = \
+            256, 2048, 232, 224, 30, 50
+
+    data_dir = tempfile.mkdtemp(prefix="zoo_bench_imagenet_")
+    try:
+        write_synthetic_imagenet(data_dir, num_images=num_images,
+                                 image_size=image_size, shard_size=1024)
+        pipe = ImageNetPipeline(data_dir, batch_size=batch, mesh=ctx.mesh,
+                                crop_size=crop, train=True)
+        # reference LR recipe: peak 0.1*global/256, 5-epoch warmup, poly decay
+        peak = 0.1 * pipe.global_bs / 256
+        warm = 5 * pipe.steps_per_epoch
+        sched = (SequentialSchedule()
+                 .add(Warmup(delta=peak / warm), warm)
+                 .add(Poly(2.0, 85 * pipe.steps_per_epoch),
+                      85 * pipe.steps_per_epoch))
+        est = TPUEstimator(
+            resnet(depth=depth, num_classes=1000),
+            loss="sparse_categorical_crossentropy",
+            optimizer=SGD(learningrate=0.0, momentum=0.9,
+                          leaningrate_schedule=sched))
+
+        sample = next(pipe.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in sample.x))
+        hb = list(pipe._host_batches(True))
+        # compile + warm (value fetch forces completion)
+        float(est.engine.train_batch(hb[0]))
+        float(est.engine.train_batch(hb[1 % len(hb)]))
+
+        flops_fallback = 3 * 4.09e9 * (crop / 224) ** 2 * batch
+        step_flops = _step_flops(
+            est.engine._jit_train,
+            (est.engine.params, est.engine.extra_vars, est.engine.opt_state,
+             0, tuple(np.asarray(a) for a in hb[0].x),
+             tuple(np.asarray(a) for a in hb[0].y), hb[0].w),
+            flops_fallback)
+
+        # 1) compute-only: device-resident batches, fetch once at the end
+        dev = [pipe._put_batch(b) for b in hb[:4]]
+        float(est.engine.train_batch(dev[0]))
+        t0 = time.perf_counter()
+        n = 0
+        while n < steps:
+            for b in dev:
+                loss = est.engine.train_batch(b)
+                n += 1
+                if n >= steps:
+                    break
+        float(loss)
+        dt_compute = (time.perf_counter() - t0) / steps
+
+        # 2) transfer probe with live training state (the e2e constraint)
+        probe = np.random.randint(0, 255, hb[0].x[0].shape, np.uint8)
+        t0 = time.perf_counter()
+        jax.device_put(probe).block_until_ready()
+        hot_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+
+        # 3) end-to-end: every step assembles a fresh host batch from the
+        #    memory-mapped shards and feeds it straight into the jit
+        t0 = time.perf_counter()
+        n = 0
+        while n < steps:
+            for b in pipe._host_batches(True):
+                loss = est.engine.train_batch(b)
+                n += 1
+                if n >= steps:
+                    break
+        float(loss)
+        dt_e2e = (time.perf_counter() - t0) / steps
+
+        nchip = max(jax.device_count(), 1)
+        peak_rate = sum(_peak_flops(d) for d in jax.devices())
+        e2e = batch / dt_e2e / nchip
+        comp = batch / dt_compute / nchip
+        return {"metric": "resnet50_imagenet_train_throughput_per_chip",
+                "value": round(e2e, 1), "unit": "samples/sec/chip",
+                "vs_baseline": round(e2e / RESNET_BASELINE, 3),
+                "compute_samples_per_sec_per_chip": round(comp, 1),
+                "compute_vs_baseline": round(comp / RESNET_BASELINE, 3),
+                "mfu_compute": (round(step_flops / dt_compute / peak_rate, 4)
+                                if peak_rate else None),
+                "mfu_e2e": (round(step_flops / dt_e2e / peak_rate, 4)
+                            if peak_rate else None),
+                "hot_transfer_MBps": round(hot_mbps, 1),
+                "batch": batch, "depth": depth, "crop": crop,
+                "streamed": True, "step_flops": step_flops}
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def bench_ncf(smoke: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.common.context import get_context
     from analytics_zoo_tpu.models.recommendation import NeuralCF
     from analytics_zoo_tpu.orca.learn.optimizers import Adam
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
 
-    init_orca_context("local")
-
+    ctx = get_context()
     n_users, n_items = 6040, 3706
-    batch = 16384
-    steps_measured = 50
+    batch = 2048 if smoke else 16384
+    steps = 10 if smoke else 50
 
     rng = np.random.RandomState(0)
-    n = batch * 4
+    n = batch * 8
     pairs = np.stack([rng.randint(1, n_users, n),
                       rng.randint(1, n_items, n)], -1).astype(np.int32)
     ratings = rng.randint(0, 5, n).astype(np.int32)
 
-    import jax.numpy as jnp
     model = NeuralCF(user_count=n_users, item_count=n_items, class_num=5,
                      user_embed=64, item_embed=64, hidden_layers=(128, 64, 32),
                      mf_embed=64, compute_dtype=jnp.bfloat16)
@@ -52,36 +206,50 @@ def main():
                   optimizer=Adam(lr=1e-3), metrics=None)
     est = model.estimator
 
-    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
-    it = data_to_iterator({"x": pairs, "y": ratings}, batch, est.ctx.mesh,
-                          shuffle=False)
-    batches = list(it.epoch())
+    it = data_to_iterator({"x": pairs, "y": ratings}, batch, ctx.mesh,
+                          shuffle=True)
     est.engine.build((pairs[:1],))
+    first = next(it._host_batches(True))
+    float(est.engine.train_batch(first))
+    float(est.engine.train_batch(first))
 
-    # warmup/compile
-    for b in batches[:2]:
-        est.engine.train_batch(b)
-    jax.block_until_ready(est.engine.params)
-
+    # e2e: shuffle + native gather + feed, every step (fetch forces finish)
     t0 = time.perf_counter()
     done = 0
-    while done < steps_measured:
-        for b in batches:
-            est.engine.train_batch(b)
+    while done < steps:
+        for b in it._host_batches(True):
+            loss = est.engine.train_batch(b)
             done += 1
-            if done >= steps_measured:
+            if done >= steps:
                 break
-    jax.block_until_ready(est.engine.params)
-    dt = time.perf_counter() - t0
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
 
-    samples_per_sec = steps_measured * batch / dt
-    per_chip = samples_per_sec / max(jax.device_count(), 1)
-    print(json.dumps({
-        "metric": "ncf_movielens_train_throughput_per_chip",
-        "value": round(per_chip, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
-    }))
+    per_chip = batch / dt / max(jax.device_count(), 1)
+    return {"metric": "ncf_movielens_train_throughput_per_chip",
+            "value": round(per_chip, 1), "unit": "samples/sec/chip",
+            "vs_baseline": round(per_chip / NCF_BASELINE, 3),
+            "batch": batch, "streamed": True}
+
+
+def main():
+    from analytics_zoo_tpu import init_orca_context
+    init_orca_context("local")
+    smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+
+    resnet_res = bench_resnet50(smoke)
+    ncf_res = bench_ncf(smoke)
+
+    detail = {"resnet50": resnet_res, "ncf": ncf_res, "smoke": smoke}
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_DETAIL.json"), "w") as f:
+        json.dump(detail, f, indent=2)
+
+    out = dict(resnet_res)
+    out.pop("step_flops", None)
+    out["ncf_samples_per_sec_per_chip"] = ncf_res["value"]
+    out["ncf_vs_baseline"] = ncf_res["vs_baseline"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,87 @@
+"""Streaming ImageNet-style input path: synthetic shard writer, memory-mapped
+crop/flip assembly, infeed streaming, estimator fit over it, and the Warmup+
+Poly LR schedule of the reference ResNet-50 config
+(resnet-50-imagenet.py:26-33,351,382-386)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.orca.data.image import (ImageNetPipeline,
+                                               write_synthetic_imagenet)
+
+
+def test_synthetic_writer_and_shapes(orca_context, tmp_path):
+    d = write_synthetic_imagenet(str(tmp_path), num_images=70, image_size=40,
+                                 num_classes=10, shard_size=32)
+    pipe = ImageNetPipeline(d, batch_size=16, mesh=orca_context.mesh,
+                            crop_size=32, train=True)
+    assert pipe.n == 70
+    assert pipe.steps_per_epoch == 4          # drop_remainder
+    batches = list(pipe.epoch())
+    assert len(batches) == 4
+    img = np.asarray(batches[0].x[0])
+    assert img.shape == (16, 32, 32, 3) and img.dtype == np.uint8
+    lbl = np.asarray(batches[0].y[0])
+    assert lbl.shape == (16,) and lbl.dtype == np.int32
+    assert 0 <= lbl.min() and lbl.max() < 10
+
+
+def test_eval_center_crop_deterministic(orca_context, tmp_path):
+    d = write_synthetic_imagenet(str(tmp_path), num_images=32, image_size=40,
+                                 shard_size=32)
+    pipe = ImageNetPipeline(d, batch_size=16, mesh=orca_context.mesh,
+                            crop_size=32, train=False)
+    a = np.asarray(next(iter(pipe.epoch())).x[0])
+    b = np.asarray(next(iter(pipe.epoch())).x[0])
+    np.testing.assert_array_equal(a, b)       # no randomness in eval
+    # center crop: matches direct slice of the source shard
+    import os
+    src = np.load(os.path.join(d, "shard-00000-images.npy"))
+    np.testing.assert_array_equal(a[0], src[0, 4:36, 4:36])
+
+
+def test_resnet_trains_on_streamed_uint8(orca_context, tmp_path):
+    """ResNet consumes uint8 straight off the infeed (normalize fused into
+    the jit); loss decreases over a few epochs on a 2-class toy set where the
+    classes differ by brightness."""
+    import os
+    from analytics_zoo_tpu.models.image.resnet import ResNet, BasicBlock
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+
+    rng = np.random.RandomState(0)
+    n, size = 64, 40
+    labels = rng.randint(0, 2, n).astype(np.int32)
+    base = np.where(labels[:, None, None, None] == 0, 60, 190)
+    imgs = (base + rng.randint(-30, 30, (n, size, size, 3))).clip(
+        0, 255).astype(np.uint8)
+    os.makedirs(tmp_path, exist_ok=True)
+    np.save(tmp_path / "shard-00000-images.npy", imgs)
+    np.save(tmp_path / "shard-00000-labels.npy", labels)
+
+    model = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=2,
+                   num_filters=8)
+    est = TPUEstimator(model, loss="sparse_categorical_crossentropy",
+                       optimizer="adam")
+    pipe = ImageNetPipeline(str(tmp_path), batch_size=16,
+                            mesh=orca_context.mesh, crop_size=32, train=True)
+    stats = est.fit(pipe, epochs=4, batch_size=16, verbose=False)
+    assert stats[-1]["train_loss"] < stats[0]["train_loss"]
+
+
+def test_warmup_poly_schedule_values(orca_context):
+    """Reference LR recipe: warmup to 0.1*global/256 over 5 epochs, then
+    polynomial decay (resnet-50-imagenet.py:351,382-386)."""
+    from analytics_zoo_tpu.orca.learn.optimizers.schedule import (
+        Poly, SequentialSchedule, Warmup)
+    peak = 0.1 * 256 / 256
+    warm_steps, total = 10, 100
+    sched = (SequentialSchedule()
+             .add(Warmup(delta=peak / warm_steps), warm_steps)
+             .add(Poly(power=2.0, max_iteration=total - warm_steps),
+                  total - warm_steps))
+    fn = sched.to_optax(0.0)
+    lrs = [float(fn(i)) for i in range(total)]
+    assert lrs[0] < lrs[5] < lrs[9] <= peak + 1e-6   # rising during warmup
+    assert abs(lrs[warm_steps] - peak) < 0.02 * peak  # decay starts at peak
+    assert lrs[-1] < lrs[15] < peak                   # decaying after
+    assert lrs[-1] < 0.01 * peak
