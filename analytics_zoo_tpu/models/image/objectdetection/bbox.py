@@ -1,0 +1,95 @@
+"""Box geometry primitives for object detection.
+
+Reference behavior: ``zoo/src/main/scala/com/intel/analytics/zoo/models/image/
+objectdetection/common/BboxUtil.scala`` (encode/decode with prior variances,
+jaccard overlap, clipping). Rebuilt TPU-first: every function is a pure,
+static-shape ``jnp`` op over *batched* box tensors, so the whole detection
+loss and postprocessing pipeline traces into one XLA program — no per-box
+Scala loops like the reference's ``BboxUtil.getBboxes``/``encodeBBox`` scalar
+code. Boxes are normalized to [0, 1].
+
+Conventions:
+  * "corner" form: ``(x1, y1, x2, y2)``
+  * "center" form: ``(cx, cy, w, h)`` — priors are stored in center form,
+    matching the SSD parametrization the reference encodes against.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# SSD variances (BboxUtil encode/decode "variance" scaling; same constants the
+# reference's ObjectDetectionConfig uses for every SSD model family).
+DEFAULT_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def center_to_corner(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(cx, cy, w, h) -> (x1, y1, x2, y2). Works on [..., 4]."""
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+def corner_to_center(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(x1, y1, x2, y2) -> (cx, cy, w, h). Works on [..., 4]."""
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Corner-form box area, [...] -> [...]."""
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return w * h
+
+
+def iou_matrix(boxes_a: jnp.ndarray, boxes_b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU between two corner-form box sets.
+
+    [M, 4] x [A, 4] -> [M, A]. One broadcasted op — the reference's
+    ``BboxUtil.jaccardOverlap`` computed per pair inside matching loops.
+    """
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area(boxes_a)[:, None] + area(boxes_b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def encode_boxes(matched: jnp.ndarray, priors: jnp.ndarray,
+                 variances: Tuple[float, ...] = DEFAULT_VARIANCES
+                 ) -> jnp.ndarray:
+    """Encode corner-form GT boxes against center-form priors.
+
+    [A, 4] x [A, 4] -> [A, 4] regression targets
+    (BboxUtil.encodeBBox semantics: offset of centers scaled by prior size and
+    variance; log-scaled width/height ratios).
+    """
+    m = corner_to_center(matched)
+    g_cxcy = (m[..., :2] - priors[..., :2]) / jnp.maximum(
+        priors[..., 2:], 1e-10)
+    g_cxcy = g_cxcy / jnp.asarray(variances[:2])
+    g_wh = jnp.log(jnp.maximum(m[..., 2:], 1e-10) /
+                   jnp.maximum(priors[..., 2:], 1e-10))
+    g_wh = g_wh / jnp.asarray(variances[2:])
+    return jnp.concatenate([g_cxcy, g_wh], axis=-1)
+
+
+def decode_boxes(loc: jnp.ndarray, priors: jnp.ndarray,
+                 variances: Tuple[float, ...] = DEFAULT_VARIANCES
+                 ) -> jnp.ndarray:
+    """Inverse of :func:`encode_boxes`: [..., A, 4] loc predictions ->
+    corner-form boxes (BboxUtil.decodeBoxes)."""
+    v = jnp.asarray(variances)
+    cxcy = priors[..., :2] + loc[..., :2] * v[:2] * priors[..., 2:]
+    wh = priors[..., 2:] * jnp.exp(loc[..., 2:] * v[2:])
+    return center_to_corner(jnp.concatenate([cxcy, wh], axis=-1))
+
+
+def clip_boxes(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Clip corner-form boxes into [0, 1] (Postprocessor.scala clipBoxes)."""
+    return jnp.clip(boxes, 0.0, 1.0)

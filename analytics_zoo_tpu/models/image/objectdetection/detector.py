@@ -1,0 +1,221 @@
+"""ObjectDetector model-zoo API + label maps + visualizer.
+
+Reference surface: ``pyzoo/zoo/models/image/objectdetection/object_detector.py``
+(ObjectDetector.load_model / predict_image_set, read_pascal_label_map,
+read_coco_label_map, Visualizer) backed by Scala
+``models/image/objectdetection/ObjectDetector.scala`` + ``Visualizer.scala``.
+
+TPU-native: the detector is an SSD flax module trained by the one jitted
+Orca engine with the multibox loss; prediction runs the jitted decode+NMS
+postprocessor, so an entire serving batch is one XLA program.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...common.zoo_model import ZooModel
+from .loss import multibox_loss
+from .postprocess import decode_detections, scale_detections
+from .ssd import SSD, ssd_300, ssd_tiny
+
+PASCAL_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+COCO_CLASSES = (
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep", "cow",
+    "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella", "handbag",
+    "tie", "suitcase", "frisbee", "skis", "snowboard", "sports ball", "kite",
+    "baseball bat", "baseball glove", "skateboard", "surfboard",
+    "tennis racket", "bottle", "wine glass", "cup", "fork", "knife", "spoon",
+    "bowl", "banana", "apple", "sandwich", "orange", "broccoli", "carrot",
+    "hot dog", "pizza", "donut", "cake", "chair", "couch", "potted plant",
+    "bed", "dining table", "toilet", "tv", "laptop", "mouse", "remote",
+    "keyboard", "cell phone", "microwave", "oven", "toaster", "sink",
+    "refrigerator", "book", "clock", "vase", "scissors", "teddy bear",
+    "hair drier", "toothbrush")
+
+
+def read_pascal_label_map() -> dict:
+    """label -> 1-based index (reference: readPascalLabelMap via LabelReader)."""
+    return {name: i + 1 for i, name in enumerate(PASCAL_CLASSES)}
+
+
+def read_coco_label_map() -> dict:
+    return {name: i + 1 for i, name in enumerate(COCO_CLASSES)}
+
+
+class ObjectDetector(ZooModel):
+    """SSD object detector with the reference's model-zoo surface."""
+
+    def __init__(self, class_names: Sequence[str] = PASCAL_CLASSES,
+                 image_size: int = 300, model_type: str = "ssd300",
+                 max_gt: int = 32, **net_kwargs):
+        self.class_names = tuple(class_names)
+        self.image_size = int(image_size)
+        self.model_type = model_type
+        self.max_gt = int(max_gt)
+        self._net_kwargs = dict(net_kwargs)
+        num_classes = len(self.class_names) + 1      # + background
+        if model_type == "ssd300":
+            module = ssd_300(num_classes, **net_kwargs)
+        elif model_type == "ssd_tiny":
+            module = ssd_tiny(num_classes, image_size=image_size,
+                              **net_kwargs)
+        else:
+            raise ValueError(f"unknown model_type {model_type!r} "
+                             "(known: ssd300, ssd_tiny)")
+        super().__init__(module)
+        self.priors = module.priors()
+
+    # --- training -----------------------------------------------------------
+    def compile(self, loss=None, optimizer="adam", metrics=None, **kwargs):
+        if loss is None:
+            loss = multibox_loss(self.priors)
+        return super().compile(loss=loss, optimizer=optimizer,
+                               metrics=metrics, **kwargs)
+
+    @staticmethod
+    def pack_targets(boxes_list: Sequence[np.ndarray],
+                     labels_list: Sequence[np.ndarray],
+                     max_gt: int) -> np.ndarray:
+        """Ragged per-image (boxes [m,4], labels [m]) -> padded [B, max_gt, 5]
+        (x1,y1,x2,y2,label); pad rows have label 0. The static-shape analogue
+        of the reference's SSDMiniBatch roi tensors."""
+        b = len(boxes_list)
+        out = np.zeros((b, max_gt, 5), dtype=np.float32)
+        for i, (bx, lb) in enumerate(zip(boxes_list, labels_list)):
+            m = min(len(lb), max_gt)
+            if m:
+                out[i, :m, :4] = np.asarray(bx, dtype=np.float32)[:m]
+                out[i, :m, 4] = np.asarray(lb, dtype=np.float32)[:m]
+        return out
+
+    # --- inference ----------------------------------------------------------
+    def predict_image_set(self, image_set, score_threshold: float = 0.05,
+                          nms_threshold: float = 0.45,
+                          max_detections: int = 100,
+                          batch_size: int = 32,
+                          original_sizes: Optional[List] = None):
+        """ImageSet/ndarray -> [B, max_detections, 6] (label, score, box).
+
+        Boxes come back in pixel coords of the *input* images (the
+        reference's ScaleDetection step); pass ``original_sizes`` as a list of
+        (height, width) to rescale to pre-resize frames instead.
+        """
+        from ....feature.image.imageset import ImageSet
+        if isinstance(image_set, ImageSet):
+            imgs = np.stack(image_set.get_image())
+        else:
+            imgs = np.asarray(image_set)
+        loc, conf = self.predict(imgs, batch_size=batch_size)
+        dets = np.asarray(decode_detections(
+            loc, conf, self.priors, score_threshold=score_threshold,
+            nms_threshold=nms_threshold, max_detections=max_detections))
+        if original_sizes is None:
+            h = w = self.image_size
+            return scale_detections(dets, w, h)
+        out = np.empty_like(dets)
+        for i, (h, w) in enumerate(original_sizes):
+            out[i] = scale_detections(dets[i], w, h)
+        return out
+
+    def as_inference_model(self, score_threshold: float = 0.05,
+                           nms_threshold: float = 0.45,
+                           max_detections: int = 100):
+        """Wrap the trained detector as an :class:`InferenceModel` whose
+        ``predict`` returns decoded (label, score, box) detections — the unit
+        ClusterServing serves (BASELINE config #5: object-detection serving).
+        The SSD forward and the NMS postprocessor fuse into one XLA program
+        per batch bucket."""
+        from ....pipeline.inference.inference_model import InferenceModel
+
+        ssd_module, priors = self.module, self.priors
+
+        class _Servable:
+            def apply(self, variables, x):
+                loc, conf = ssd_module.apply(variables, x)
+                return decode_detections(
+                    loc, conf, priors, score_threshold=score_threshold,
+                    nms_threshold=nms_threshold,
+                    max_detections=max_detections)
+
+        engine = self.estimator.engine
+        variables = {"params": engine.params, **engine.extra_vars}
+        return InferenceModel().load_jax(_Servable(), variables)
+
+    # --- persistence --------------------------------------------------------
+    def save_model(self, path: str, over_write: bool = False):
+        import os
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(path)
+        blob = {
+            "cls": "ObjectDetector",
+            "cfg": {"class_names": self.class_names,
+                    "image_size": self.image_size,
+                    "model_type": self.model_type,
+                    "max_gt": self.max_gt,
+                    "net_kwargs": self._net_kwargs},
+            "state": self.estimator.engine.get_state(),
+        }
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+        return path
+
+    @classmethod
+    def load_model(cls, path: str, weight_path: Optional[str] = None):
+        """(reference: ObjectDetector.load_model — weight_path kept for
+        source compatibility; the single pickle carries the weights)."""
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        cfg = blob["cfg"]
+        model = cls(class_names=cfg["class_names"],
+                    image_size=cfg["image_size"],
+                    model_type=cfg["model_type"], max_gt=cfg["max_gt"],
+                    **cfg.get("net_kwargs", {}))
+        model.compile()
+        est = model.estimator
+        dummy = np.zeros((1, cfg["image_size"], cfg["image_size"], 3),
+                         dtype=np.float32)
+        est.engine.build((dummy,))
+        est.engine.set_state(blob["state"])
+        return model
+
+
+class Visualizer:
+    """Draw detection boxes into an image array (reference:
+    models/image/objectdetection/Visualizer.scala — rendered rectangles +
+    labels; here: pure-numpy rectangle outlines, no font rendering)."""
+
+    def __init__(self, class_names: Sequence[str] = PASCAL_CLASSES,
+                 thresh: float = 0.3, line: int = 2):
+        self.class_names = tuple(class_names)
+        self.thresh = thresh
+        self.line = line
+
+    def visualize(self, image: np.ndarray, detections: np.ndarray
+                  ) -> np.ndarray:
+        img = np.array(image, copy=True)
+        h, w = img.shape[:2]
+        color = np.asarray([255, 64, 64], dtype=img.dtype)[:img.shape[-1]] \
+            if img.ndim == 3 else 255
+        for det in detections:
+            label, score = det[0], det[1]
+            if label < 0 or score < self.thresh:
+                continue
+            x1, y1, x2, y2 = det[2:6]
+            x1 = int(np.clip(x1, 0, w - 1)); x2 = int(np.clip(x2, 0, w - 1))
+            y1 = int(np.clip(y1, 0, h - 1)); y2 = int(np.clip(y2, 0, h - 1))
+            t = self.line
+            img[y1:y1 + t, x1:x2 + 1] = color
+            img[max(y2 - t + 1, 0):y2 + 1, x1:x2 + 1] = color
+            img[y1:y2 + 1, x1:x1 + t] = color
+            img[y1:y2 + 1, max(x2 - t + 1, 0):x2 + 1] = color
+        return img

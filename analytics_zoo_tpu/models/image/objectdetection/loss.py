@@ -1,0 +1,130 @@
+"""MultiBox loss — SSD training objective.
+
+Reference: ``zoo/.../models/image/objectdetection/common/loss/`` (the ~622-LoC
+``MultiBoxLoss.scala``): match priors to ground truth by jaccard overlap,
+smooth-L1 on matched localization offsets, cross-entropy with 3:1 hard
+negative mining on confidences.
+
+TPU-first rebuild: the reference runs per-image Scala loops (match, sort
+negatives, gather). Here matching is one masked [M, A] IoU argmax, hard
+negative mining is the double-argsort rank trick, and the whole loss is
+``vmap``-ed over the batch — fully static shapes, one fused XLA computation.
+Ragged ground truth is handled by padding to ``max_gt`` boxes with label 0
+(label convention: 0 = background/pad, 1..C-1 = foreground classes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .bbox import DEFAULT_VARIANCES, encode_boxes, iou_matrix
+
+
+def match_priors(gt_boxes: jnp.ndarray, gt_labels: jnp.ndarray,
+                 priors_corner: jnp.ndarray,
+                 iou_threshold: float = 0.5
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Assign each prior a GT box (or background) for one image.
+
+    gt_boxes: [M, 4] corner-form, padded rows arbitrary
+    gt_labels: [M] int, 0 for padded rows
+    priors_corner: [A, 4] corner-form priors
+    Returns (matched_labels [A] int, matched_boxes [A, 4]).
+
+    Semantics match MultiBoxLoss matching: per-prior best GT above the IoU
+    threshold, plus every valid GT claims its single best prior regardless of
+    threshold (the reference's bipartite pass) so no GT goes unmatched.
+    """
+    valid = gt_labels > 0                                  # [M]
+    iou = iou_matrix(gt_boxes, priors_corner)              # [M, A]
+    iou = jnp.where(valid[:, None], iou, -1.0)
+
+    best_gt = jnp.argmax(iou, axis=0)                      # [A]
+    best_gt_iou = jnp.max(iou, axis=0)                     # [A]
+
+    # Bipartite pass: GT m's best prior is forced to match m with IoU 2.0
+    # (always above threshold). Padded GTs scatter out of bounds and drop.
+    best_prior = jnp.argmax(iou, axis=1)                   # [M]
+    num_priors = priors_corner.shape[0]
+    scatter_idx = jnp.where(valid, best_prior, num_priors)
+    best_gt = best_gt.at[scatter_idx].set(
+        jnp.arange(gt_labels.shape[0]), mode="drop")
+    best_gt_iou = best_gt_iou.at[scatter_idx].set(2.0, mode="drop")
+
+    matched_labels = jnp.where(best_gt_iou >= iou_threshold,
+                               gt_labels[best_gt], 0)
+    matched_boxes = gt_boxes[best_gt]
+    return matched_labels, matched_boxes
+
+
+def _smooth_l1(x: jnp.ndarray) -> jnp.ndarray:
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+def _multibox_loss_single(loc_pred, conf_logits, gt_boxes, gt_labels,
+                          priors_center, priors_corner, variances,
+                          neg_pos_ratio, iou_threshold):
+    """Per-image loss. loc_pred [A,4], conf_logits [A,C]."""
+    labels, boxes = match_priors(gt_boxes, gt_labels, priors_corner,
+                                 iou_threshold)
+    pos = labels > 0                                       # [A]
+    num_pos = jnp.sum(pos)
+
+    # Localization: smooth-L1 on positives against encoded targets.
+    targets = encode_boxes(boxes, priors_center, variances)
+    loc_l = jnp.sum(_smooth_l1(loc_pred - targets), axis=-1)
+    loc_loss = jnp.sum(jnp.where(pos, loc_l, 0.0))
+
+    # Confidence: CE everywhere; hard negative mining keeps the
+    # neg_pos_ratio * num_pos highest-loss background priors.
+    logp = jax.nn.log_softmax(conf_logits, axis=-1)        # [A, C]
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    neg_score = jnp.where(pos, -jnp.inf, ce)
+    # double-argsort rank: rank[a] = position of prior a in descending order
+    order = jnp.argsort(-neg_score)
+    rank = jnp.argsort(order)
+    num_neg = jnp.minimum(neg_pos_ratio * num_pos,
+                          jnp.sum(~pos))
+    neg = rank < num_neg
+    conf_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0))
+
+    denom = jnp.maximum(num_pos.astype(loc_pred.dtype), 1.0)
+    return (loc_loss + conf_loss) / denom
+
+
+def multibox_loss(priors: jnp.ndarray,
+                  variances=DEFAULT_VARIANCES,
+                  neg_pos_ratio: int = 3,
+                  iou_threshold: float = 0.5):
+    """Build the estimator-compatible loss: (y_true, y_pred) -> [B] losses.
+
+    ``y_true`` = (gt_boxes [B, M, 4], gt_labels [B, M]);
+    ``y_pred`` = (loc [B, A, 4], conf_logits [B, A, C]) from the SSD head.
+    ``priors`` is the constant center-form [A, 4] prior set.
+    """
+    from .bbox import center_to_corner
+    priors = jnp.asarray(priors)
+    priors_corner = center_to_corner(priors)
+
+    def loss_fn(y_true, y_pred):
+        if isinstance(y_true, (list, tuple)):
+            gt_boxes, gt_labels = y_true[0], y_true[1]
+        else:  # single packed array [B, M, 5] = (x1,y1,x2,y2,label)
+            gt_boxes = y_true[..., :4]
+            gt_labels = y_true[..., 4]
+        gt_labels = gt_labels.astype(jnp.int32)
+        loc_pred, conf_logits = y_pred
+        per_image = jax.vmap(
+            partial(_multibox_loss_single,
+                    priors_center=priors, priors_corner=priors_corner,
+                    variances=variances, neg_pos_ratio=neg_pos_ratio,
+                    iou_threshold=iou_threshold)
+        )(loc_pred, conf_logits, gt_boxes, gt_labels)
+        return per_image
+
+    return loss_fn

@@ -1,0 +1,116 @@
+"""Detection postprocessing: decode -> threshold -> NMS -> top-k.
+
+Reference: ``zoo/.../models/image/objectdetection/Postprocessor.scala``
+(ScaleDetection / DecodeOutput) and the NMS inside ``BboxUtil.scala``.
+
+TPU-first rebuild: the reference's postprocessor is host-side Scala over
+per-image Tensors. Here the whole pipeline is a static-shape jitted function:
+per-class NMS is done in ONE pass using the batched-NMS trick (offset each
+box by ``class_id * 2`` so boxes of different classes can never overlap),
+greedy suppression is a ``lax.fori_loop`` over a fixed candidate budget, and
+output is a fixed [max_detections, 6] tensor padded with score 0 / label -1 —
+the shape XLA needs so serving never recompiles on detection count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .bbox import DEFAULT_VARIANCES, clip_boxes, decode_boxes, iou_matrix
+
+
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
+        max_output: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS over a fixed-size candidate set.
+
+    boxes [K, 4] corner-form, scores [K] (0 for padded slots).
+    Returns (keep_mask [K] bool, order [K] descending-score indices).
+    """
+    k = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    scores_s = scores[order]
+    iou = iou_matrix(boxes_s, boxes_s)                     # [K, K]
+
+    def body(i, keep):
+        # suppress j > i overlapping box i, if i itself is still kept
+        suppress = (iou[i] > iou_threshold) & (jnp.arange(k) > i) & keep[i]
+        return keep & ~suppress
+
+    keep = scores_s > 0.0
+    keep = jax.lax.fori_loop(0, k, body, keep)
+    # enforce max_output: keep only the first max_output surviving slots
+    kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    keep = keep & (kept_rank < max_output)
+    return keep, order
+
+
+@partial(jax.jit, static_argnames=("top_k", "max_detections",
+                                   "score_threshold", "nms_threshold"))
+def _decode_batch(loc, conf_logits, priors, variances,
+                  score_threshold: float, nms_threshold: float,
+                  top_k: int, max_detections: int):
+    def one(loc_i, conf_i):
+        boxes = clip_boxes(decode_boxes(loc_i, priors, variances))  # [A, 4]
+        probs = jax.nn.softmax(conf_i, axis=-1)                     # [A, C]
+        probs = probs[:, 1:]                                        # drop bg
+        num_classes = probs.shape[1]
+        # flatten (prior, class) pairs, take top_k candidates
+        flat = probs.reshape(-1)                                    # [A*C']
+        flat = jnp.where(flat >= score_threshold, flat, 0.0)
+        cand_scores, cand_idx = jax.lax.top_k(flat, top_k)
+        prior_idx = cand_idx // num_classes
+        cls_idx = cand_idx % num_classes                            # 0-based fg
+        cand_boxes = boxes[prior_idx]
+        # batched-NMS trick: shift per class so cross-class IoU is 0
+        shifted = cand_boxes + cls_idx[:, None].astype(cand_boxes.dtype) * 2.0
+        keep, order = nms(shifted, cand_scores, nms_threshold, max_detections)
+        # gather in score order, padded tail gets score 0 / label -1
+        boxes_o = cand_boxes[order]
+        scores_o = cand_scores[order]
+        labels_o = cls_idx[order] + 1                                # 1-based
+        valid = keep & (scores_o > 0.0)
+        rank = jnp.where(valid, jnp.cumsum(valid.astype(jnp.int32)) - 1,
+                         max_detections)
+        out = jnp.full((max_detections + 1, 6), 0.0, boxes.dtype)
+        out = out.at[:, 0].set(-1.0)
+        rows = jnp.concatenate(
+            [labels_o[:, None].astype(boxes.dtype),
+             scores_o[:, None], boxes_o], axis=-1)
+        out = out.at[rank].set(rows, mode="drop")
+        return out[:max_detections]
+
+    return jax.vmap(one)(loc, conf_logits)
+
+
+def decode_detections(loc: jnp.ndarray, conf_logits: jnp.ndarray,
+                      priors: jnp.ndarray,
+                      variances=DEFAULT_VARIANCES,
+                      score_threshold: float = 0.05,
+                      nms_threshold: float = 0.45,
+                      top_k: int = 256,
+                      max_detections: int = 100) -> jnp.ndarray:
+    """[B, A, 4] loc + [B, A, C] logits -> [B, max_detections, 6] detections
+    ``(label, score, x1, y1, x2, y2)`` in normalized coords, padded with
+    label -1 (DecodeOutput's (label, score, bbox) record layout)."""
+    return _decode_batch(loc, conf_logits, jnp.asarray(priors),
+                         jnp.asarray(variances, dtype=loc.dtype),
+                         score_threshold=float(score_threshold),
+                         nms_threshold=float(nms_threshold),
+                         top_k=int(top_k), max_detections=int(max_detections))
+
+
+def scale_detections(dets, width: int, height: int):
+    """Normalized detections -> pixel coords of the original image
+    (Postprocessor.scala ScaleDetection)."""
+    import numpy as np
+    out = np.asarray(dets).copy()
+    out[..., 2] *= width
+    out[..., 4] *= width
+    out[..., 3] *= height
+    out[..., 5] *= height
+    return out

@@ -1,0 +1,253 @@
+"""Object detection stack tests — box utils, matching, loss, NMS, SSD
+training on toy data, persistence, and serving e2e.
+
+Mirrors the reference's Scala specs for BboxUtil/MultiBoxLoss/Postprocessor
+(zoo/src/test/.../models/image/objectdetection/) at behavior level.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.image.objectdetection import (
+    ObjectDetector, Visualizer, center_to_corner, corner_to_center,
+    decode_boxes, decode_detections, encode_boxes, generate_priors,
+    iou_matrix, match_priors, multibox_loss, nms, read_coco_label_map,
+    read_pascal_label_map, ssd_tiny, tiny_specs)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# --- bbox geometry ----------------------------------------------------------
+
+def test_corner_center_roundtrip():
+    rng = np.random.RandomState(0)
+    c = rng.rand(10, 4).astype(np.float32)
+    c[:, 2:] = c[:, 2:] * 0.3 + 0.05          # positive w/h
+    back = _np(corner_to_center(center_to_corner(jnp.asarray(c))))
+    np.testing.assert_allclose(back, c, atol=1e-6)
+
+
+def test_iou_matrix_known_values():
+    a = jnp.asarray([[0.0, 0.0, 0.5, 0.5]])
+    b = jnp.asarray([[0.0, 0.0, 0.5, 0.5],      # identical -> 1
+                     [0.25, 0.25, 0.75, 0.75],  # quarter overlap
+                     [0.6, 0.6, 0.9, 0.9]])     # disjoint -> 0
+    iou = _np(iou_matrix(a, b))[0]
+    assert iou[0] == pytest.approx(1.0, abs=1e-6)
+    # inter = 0.0625, union = 0.25 + 0.25 - 0.0625
+    assert iou[1] == pytest.approx(0.0625 / 0.4375, abs=1e-6)
+    assert iou[2] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = generate_priors(64, tiny_specs(64))
+    gt = rng.rand(priors.shape[0], 4).astype(np.float32)
+    gt = np.sort(gt.reshape(-1, 2, 2), axis=1).reshape(-1, 4)  # x1<x2, y1<y2
+    gt[:, 2:] = np.maximum(gt[:, 2:], gt[:, :2] + 0.05)
+    enc = encode_boxes(jnp.asarray(gt), jnp.asarray(priors))
+    dec = _np(decode_boxes(enc, jnp.asarray(priors)))
+    np.testing.assert_allclose(dec, gt, atol=1e-4)
+
+
+# --- matching + loss --------------------------------------------------------
+
+def test_match_priors_assigns_best_and_background():
+    priors = generate_priors(64, tiny_specs(64))
+    priors_corner = _np(center_to_corner(jnp.asarray(priors)))
+    # gt equals prior 5 exactly -> that prior must match label 2
+    gt_boxes = np.zeros((4, 4), np.float32)
+    gt_labels = np.zeros((4,), np.int32)
+    gt_boxes[0] = priors_corner[5]
+    gt_labels[0] = 2
+    labels, boxes = match_priors(jnp.asarray(gt_boxes),
+                                 jnp.asarray(gt_labels),
+                                 jnp.asarray(priors_corner))
+    labels = _np(labels)
+    assert labels[5] == 2
+    # padded gts must not create matches: every matched prior has label 2
+    assert set(np.unique(labels)) <= {0, 2}
+    np.testing.assert_allclose(_np(boxes)[5], priors_corner[5], atol=1e-6)
+
+
+def test_multibox_loss_prefers_correct_predictions():
+    rng = np.random.RandomState(2)
+    priors = generate_priors(64, tiny_specs(64))
+    a = priors.shape[0]
+    num_classes = 4
+    priors_corner = _np(center_to_corner(jnp.asarray(priors)))
+    gt_boxes = np.zeros((2, 3, 4), np.float32)
+    gt_labels = np.zeros((2, 3), np.float32)
+    gt_boxes[:, 0] = priors_corner[7]
+    gt_labels[:, 0] = 1
+    loss_fn = multibox_loss(priors)
+    y = (jnp.asarray(gt_boxes), jnp.asarray(gt_labels))
+
+    # perfect prediction: exact encoded targets + confident matched labels
+    m_labels, m_boxes = match_priors(jnp.asarray(gt_boxes[0]),
+                                     jnp.asarray(gt_labels[0], jnp.int32),
+                                     jnp.asarray(priors_corner))
+    targets = _np(encode_boxes(m_boxes, jnp.asarray(priors)))
+    m_labels = _np(m_labels)
+    loc_perfect = np.broadcast_to(targets, (2, a, 4)).copy()
+    conf_perfect = np.zeros((2, a, num_classes), np.float32)
+    conf_perfect[:, np.arange(a), m_labels] = 12.0
+    good = float(_np(loss_fn(y, (jnp.asarray(loc_perfect),
+                                 jnp.asarray(conf_perfect)))).mean())
+
+    loc_bad = rng.randn(2, a, 4).astype(np.float32) * 2
+    conf_bad = rng.randn(2, a, num_classes).astype(np.float32)
+    bad = float(_np(loss_fn(y, (jnp.asarray(loc_bad),
+                                jnp.asarray(conf_bad)))).mean())
+    assert good < bad
+    assert good < 0.1
+
+
+def test_multibox_loss_packed_targets_form():
+    priors = generate_priors(64, tiny_specs(64))
+    a = priors.shape[0]
+    packed = np.zeros((1, 2, 5), np.float32)
+    packed[0, 0] = [0.1, 0.1, 0.4, 0.4, 1]
+    loss_fn = multibox_loss(priors)
+    out = loss_fn(jnp.asarray(packed),
+                  (jnp.zeros((1, a, 4)), jnp.zeros((1, a, 3))))
+    assert _np(out).shape == (1,)
+    assert np.isfinite(_np(out)).all()
+
+
+# --- NMS + decode -----------------------------------------------------------
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([[0.0, 0.0, 0.5, 0.5],
+                         [0.01, 0.01, 0.51, 0.51],   # dup of 0, lower score
+                         [0.6, 0.6, 0.9, 0.9],
+                         [0.0, 0.0, 0.0, 0.0]])      # pad
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.0])
+    keep, order = nms(boxes, scores, iou_threshold=0.5, max_output=10)
+    keep, order = _np(keep), _np(order)
+    kept_orig = set(order[keep].tolist())
+    assert kept_orig == {0, 2}
+
+
+def test_decode_detections_end_to_end():
+    priors = generate_priors(64, tiny_specs(64))
+    a = priors.shape[0]
+    num_classes = 3                                  # bg + 2
+    loc = np.zeros((1, a, 4), np.float32)            # boxes == priors
+    conf = np.zeros((1, a, num_classes), np.float32)
+    conf[..., 0] = 6.0
+    conf[0, 11, 0] = 0.0
+    conf[0, 11, 2] = 6.0                             # class 2 at prior 11
+    dets = _np(decode_detections(jnp.asarray(loc), jnp.asarray(conf),
+                                 priors, max_detections=8))
+    assert dets.shape == (1, 8, 6)
+    top = dets[0, 0]
+    assert top[0] == 2                               # 1-based fg label
+    assert top[1] > 0.9
+    prior_corner = _np(center_to_corner(jnp.asarray(priors[11:12])))[0]
+    np.testing.assert_allclose(top[2:6], np.clip(prior_corner, 0, 1),
+                               atol=1e-3)
+    # padded rows flagged with label -1
+    assert (dets[0, 1:, 0] <= 0).all()
+
+
+# --- SSD module + training --------------------------------------------------
+
+def _toy_detection_data(n=16, size=64, seed=0):
+    """Images with one bright square; gt box around it, label 1."""
+    rng = np.random.RandomState(seed)
+    imgs = rng.rand(n, size, size, 3).astype(np.float32) * 0.1
+    boxes, labels = [], []
+    for i in range(n):
+        s = rng.randint(size // 4, size // 2)
+        x = rng.randint(0, size - s)
+        y = rng.randint(0, size - s)
+        imgs[i, y:y + s, x:x + s] += 0.8
+        boxes.append(np.asarray([[x / size, y / size,
+                                  (x + s) / size, (y + s) / size]]))
+        labels.append(np.asarray([1]))
+    return imgs, boxes, labels
+
+
+def test_ssd_forward_shapes(orca_context):
+    import jax
+    module = ssd_tiny(num_classes=3, image_size=64)
+    priors = module.priors()
+    x = np.zeros((2, 64, 64, 3), np.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    loc, conf = module.apply(variables, x)
+    assert loc.shape == (2, priors.shape[0], 4)
+    assert conf.shape == (2, priors.shape[0], 3)
+
+
+def test_detector_trains_on_toy_data(orca_context):
+    imgs, boxes, labels = _toy_detection_data(n=16)
+    det = ObjectDetector(class_names=("square",), image_size=64,
+                         model_type="ssd_tiny", max_gt=4)
+    y = ObjectDetector.pack_targets(boxes, labels, max_gt=4)
+    det.compile(optimizer="adam")
+    stats1 = det.fit({"x": imgs, "y": y}, batch_size=8, epochs=1)
+    stats2 = det.fit({"x": imgs, "y": y}, batch_size=8, epochs=3)
+    assert stats2[-1]["train_loss"] < stats1[-1]["train_loss"]
+    dets = det.predict_image_set(imgs[:4], max_detections=10)
+    assert dets.shape == (4, 10, 6)
+
+
+def test_detector_save_load_roundtrip(orca_context, tmp_path):
+    imgs, boxes, labels = _toy_detection_data(n=8)
+    det = ObjectDetector(class_names=("square",), image_size=64,
+                         model_type="ssd_tiny", max_gt=4)
+    det.compile()
+    y = ObjectDetector.pack_targets(boxes, labels, max_gt=4)
+    det.fit({"x": imgs, "y": y}, batch_size=8, epochs=1)
+    p1 = det.predict_image_set(imgs[:2], max_detections=5)
+    path = str(tmp_path / "det.pkl")
+    det.save_model(path)
+    det2 = ObjectDetector.load_model(path)
+    p2 = det2.predict_image_set(imgs[:2], max_detections=5)
+    np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+
+def test_label_maps_and_visualizer():
+    pascal = read_pascal_label_map()
+    coco = read_coco_label_map()
+    assert pascal["aeroplane"] == 1 and len(pascal) == 20
+    assert coco["person"] == 1 and len(coco) == 80
+    img = np.zeros((32, 32, 3), np.uint8)
+    dets = np.asarray([[1, 0.9, 4, 4, 20, 20],
+                       [-1, 0.0, 0, 0, 0, 0]])
+    out = Visualizer(("square",), thresh=0.5).visualize(img, dets)
+    assert out[4, 10].sum() > 0                      # top edge drawn
+    assert out.shape == img.shape
+
+
+def test_detector_serving_e2e(orca_context):
+    """BASELINE config #5 shape: OD model served through ClusterServing."""
+    from analytics_zoo_tpu.serving import (ClusterServing, InMemoryBroker,
+                                           InputQueue, OutputQueue)
+    imgs, boxes, labels = _toy_detection_data(n=8)
+    det = ObjectDetector(class_names=("square",), image_size=64,
+                         model_type="ssd_tiny", max_gt=4)
+    det.compile()
+    y = ObjectDetector.pack_targets(boxes, labels, max_gt=4)
+    det.fit({"x": imgs, "y": y}, batch_size=8, epochs=1)
+
+    broker = InMemoryBroker()
+    serving = ClusterServing(det.as_inference_model(max_detections=10),
+                             queue=broker, batch_size=4,
+                             batch_timeout_ms=10)
+    serving.start()
+    try:
+        iq = InputQueue(broker)
+        oq = OutputQueue(broker)
+        ids = [iq.enqueue(f"img-{i}", t=imgs[i]) for i in range(4)]
+        results = [oq.query(i, timeout_s=30) for i in ids]
+    finally:
+        serving.stop()
+    for r in results:
+        arr = r if isinstance(r, np.ndarray) else r.get("prediction", r)
+        assert np.asarray(arr).shape == (10, 6)
