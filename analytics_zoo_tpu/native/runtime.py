@@ -14,16 +14,23 @@ import os
 import subprocess
 import threading
 import time
+import weakref
 from typing import Optional
 
 import numpy as np
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
-_REPO_ROOT = os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", ".."))
-_SRC = os.path.join(_REPO_ROOT, "native", "zoo_runtime.cc")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "zoo_runtime.cc")
+# build under the package dir when writable, else a per-user cache dir —
+# pip installs may land in a read-only site-packages.
+_BUILD_DIR = os.path.join(_PKG_DIR, "build")
+if not os.access(_PKG_DIR, os.W_OK):
+    _BUILD_DIR = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "analytics_zoo_tpu", "native")
 _SO = os.path.join(_BUILD_DIR, "libzoo_runtime.so")
 
 _lib = None
@@ -117,11 +124,23 @@ def version() -> str:
 # --- high-level wrappers -----------------------------------------------------
 
 class Arena:
-    """Aligned bump allocator for staging buffers (reset per epoch)."""
+    """Aligned bump allocator for staging buffers.
+
+    Lifetime contract: ``reset()`` logically invalidates previously returned
+    arrays (their memory will be reused by subsequent allocs) — callers must
+    not hold views across a reset. The native block is only freed once BOTH
+    ``close()`` (or GC of the Arena) has been requested AND no ``alloc_array``
+    views remain alive: each returned array's base buffer pins the Arena and
+    is tracked with a finalizer, and ``close()`` defers the actual
+    ``za_arena_destroy`` until the last view dies.
+    """
 
     def __init__(self, capacity: int):
         self._lib = load()
         self.capacity = capacity
+        self._live_views = 0
+        self._close_requested = False
+        self._state_lock = threading.Lock()
         if self._lib:
             self._h = self._lib.za_arena_create(capacity)
             if not self._h:
@@ -134,12 +153,27 @@ class Arena:
         dtype = np.dtype(dtype)
         nbytes = int(np.prod(shape)) * dtype.itemsize
         if self._lib:
-            ptr = self._lib.za_arena_alloc(self._h, nbytes, align)
-            if not ptr:
-                raise MemoryError("arena exhausted")
-            buf = (ctypes.c_char * nbytes).from_address(ptr)
+            with self._state_lock:
+                if self._close_requested or self._h is None:
+                    raise RuntimeError("arena is closed")
+                ptr = self._lib.za_arena_alloc(self._h, nbytes, align)
+                if not ptr:
+                    raise MemoryError("arena exhausted")
+                buf = (ctypes.c_char * nbytes).from_address(ptr)
+                # the array's .base chain ends at `buf`; pinning the Arena on
+                # it keeps the native block alive while any view exists
+                buf._zoo_arena = self
+                self._live_views += 1
+                weakref.finalize(buf, self._on_view_dead)
             return np.frombuffer(buf, dtype=dtype).reshape(shape)
         return np.empty(shape, dtype)
+
+    def _on_view_dead(self):
+        with self._state_lock:
+            self._live_views -= 1
+            do_free = self._close_requested and self._live_views == 0
+        if do_free:
+            self._destroy()
 
     @property
     def used(self) -> int:
@@ -149,10 +183,21 @@ class Arena:
         if self._lib:
             self._lib.za_arena_reset(self._h)
 
+    def _destroy(self):
+        with self._state_lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.za_arena_destroy(h)
+
     def close(self):
+        """Request teardown; frees immediately if no views are outstanding,
+        otherwise when the last view is garbage-collected."""
         if self._lib and self._h:
-            self._lib.za_arena_destroy(self._h)
-            self._h = None
+            with self._state_lock:
+                self._close_requested = True
+                do_free = self._live_views == 0
+            if do_free:
+                self._destroy()
 
     def __del__(self):
         try:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -26,6 +27,7 @@ class Variable:
     def __init__(self, shape: Optional[Tuple] = None, name: Optional[str] = None,
                  op: Any = None, parents: Sequence["Variable"] = (),
                  op_kwargs: Optional[dict] = None):
+        _install_symbolic_dispatch()  # lazily, on first symbolic tensor
         self._uid = next(_uid_counter)
         self.shape = tuple(shape) if shape is not None else None
         self.name = name or f"var_{self._uid}"
@@ -123,12 +125,28 @@ def _install_symbolic_dispatch():
     """Teach every flax module to record itself as a graph node when called
     on symbolic Variables (unbound call with Variable args). This is what
     makes ``Dense(8)(Input(shape=(4,)))`` build a DAG — for our layers AND
-    any stock flax module a user drops into the functional API."""
+    any stock flax module a user drops into the functional API.
+
+    Installed lazily on first ``Variable`` construction, so importing the
+    package never mutates flax for programs that don't use the functional
+    graph API. The patch is behavior-preserving for plain flax calls: it only
+    diverts when a symbolic Variable appears in the args (which cannot happen
+    outside this API). If a flax release renames the internal hook, we warn
+    and fall back to the ``keras_call`` decorator (our own layers still build
+    graphs; stock flax modules then need an explicit ``keras_call`` wrap)."""
+    global _dispatch_installed
+    if _dispatch_installed:
+        return
+    _dispatch_installed = True
     import flax.linen as nn
 
-    if getattr(nn.Module, "_zoo_symbolic_dispatch", False):
+    orig = getattr(nn.Module, "_call_wrapped_method", None)
+    if orig is None:
+        logging.getLogger("analytics_zoo_tpu").warning(
+            "flax.linen.Module._call_wrapped_method not found (flax version "
+            "change?); stock flax modules will not auto-record into the "
+            "functional graph — wrap them with keras_call instead")
         return
-    orig = nn.Module._call_wrapped_method
 
     def patched(self, fun, args, kwargs):
         if has_variable(args):
@@ -136,10 +154,9 @@ def _install_symbolic_dispatch():
         return orig(self, fun, args, kwargs)
 
     nn.Module._call_wrapped_method = patched
-    nn.Module._zoo_symbolic_dispatch = True
 
 
-_install_symbolic_dispatch()
+_dispatch_installed = False
 
 
 def call_layer(layer, *xs, train: bool = False):

@@ -19,11 +19,14 @@ Backends:
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu")
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -107,8 +110,17 @@ class InferenceModel:
             variables = module.init(jax.random.PRNGKey(0), sample)
             variables = loader(variables)
             return self.load_jax(module, variables)
-        except Exception:
-            # non-convertible graph: execute via call_tf (runs TF kernels)
+        except Exception as e:
+            # non-convertible graph: execute via call_tf. call_tf runs the
+            # original TF kernels on the host CPU — it will NOT compile to a
+            # TPU executable, so predict() on a TPU-only deployment fails or
+            # runs slow. Surface that now, not at predict time.
+            logger.warning(
+                "keras->flax conversion failed (%s: %s); falling back to "
+                "jax2tf.call_tf, which executes TensorFlow kernels on host "
+                "CPU and cannot be compiled for TPU. Re-export the model "
+                "with supported layers for a native TPU path.",
+                type(e).__name__, e)
             from jax.experimental import jax2tf
 
             def apply_fn(variables, *x):
@@ -129,7 +141,6 @@ class InferenceModel:
         from ...orca.learn.pytorch.torch_bridge import build_flax_from_torch
         import jax
         module, loader = build_flax_from_torch(torch_module)
-        raise_shape = None
         # lazily init on first predict (input shape unknown here)
         self._pending_torch = (module, loader)
 

@@ -1,6 +1,8 @@
 from .client import InputQueue, OutputQueue
 from .engine import ClusterServing, Timer
-from .queue_api import FileBroker, InMemoryBroker, make_broker
+from .queue_api import FileBroker, InMemoryBroker, RedisBroker, make_broker
+from .redis_protocol import MiniRedisServer, RedisClient
 
 __all__ = ["InputQueue", "OutputQueue", "ClusterServing", "Timer",
-           "InMemoryBroker", "FileBroker", "make_broker"]
+           "InMemoryBroker", "FileBroker", "RedisBroker", "MiniRedisServer",
+           "RedisClient", "make_broker"]

@@ -1,7 +1,8 @@
 """Serving client — InputQueue / OutputQueue, same surface as the reference
 (pyzoo/zoo/serving/client.py:82 InputQueue.enqueue/predict, :234
-OutputQueue.dequeue/query), but speaking to a Broker (memory:// or file://)
-instead of Redis."""
+OutputQueue.dequeue/query). Passing ``host``/``port`` selects the Redis
+transport exactly like the reference client's ``InputQueue(host, port)``;
+otherwise ``queue`` picks a broker (memory:// file:// redis://)."""
 
 from __future__ import annotations
 
@@ -16,10 +17,12 @@ from .queue_api import Broker, make_broker
 
 class API:
     def __init__(self, queue: str = "memory://serving_stream",
-                 host: Optional[str] = None, port: Optional[str] = None,
+                 host: Optional[str] = None, port=None,
                  name: str = "serving_stream"):
-        # host/port accepted for source compatibility with the Redis client
         self.name = name
+        if host is not None:
+            # reference signature: API(host, port) → Redis transport
+            queue = f"redis://{host}:{int(port or 6379)}/{name}"
         self.broker: Broker = make_broker(queue) if isinstance(queue, str) \
             else queue
 

@@ -73,3 +73,23 @@ def run_frontend(queue="memory://serving_stream", host: str = "0.0.0.0",
                  port: int = 10020):
     from aiohttp import web
     web.run_app(create_app(queue), host=host, port=port)
+
+
+def main(argv=None):
+    """Console entry point (``zoo-serving``) — mirrors the reference's
+    cluster-serving-start script (scripts/cluster-serving/)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description="analytics-zoo-tpu serving "
+                                            "HTTP frontend")
+    p.add_argument("--queue", default="memory://serving_stream",
+                   help="broker URI (memory://<stream> or "
+                        "redis://host:port/<stream>)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=10020)
+    args = p.parse_args(argv)
+    run_frontend(queue=args.queue, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

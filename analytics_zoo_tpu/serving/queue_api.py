@@ -9,9 +9,10 @@ two TPU-host-friendly backends:
 * InMemoryBroker  — intra-process (tests, embedded serving)
 * FileBroker      — spool-directory stream + result files; works across
   processes on one host or over a shared filesystem, no external service
-
-A Redis backend can slot in later behind the same three methods
-(enqueue/claim_batch/put_result) when deployments have Redis available.
+* RedisBroker     — the reference's actual transport: XADD onto a stream,
+  XREADGROUP/XACK consumer-group claims, HSET results — over our own RESP2
+  client (redis_protocol.py), so it works against real Redis or the bundled
+  MiniRedisServer with no redis-py dependency.
 """
 
 from __future__ import annotations
@@ -158,9 +159,118 @@ class FileBroker(Broker):
                     if not n.startswith(".")])
 
 
+class RedisBroker(Broker):
+    """Redis-streams transport (reference: FlinkRedisSource.scala:78-104).
+
+    Input records are XADDed to ``<stream>`` with fields ``uri``/``data``;
+    the engine side claims them with XREADGROUP on consumer group ``group``
+    and XACKs after hand-off. Results go to hash ``result:<id>`` field
+    ``value`` (reference sink pipelines HSETs, FlinkRedisSink.scala:29) and
+    are deleted on read, matching the reference client's get-then-forget
+    polling loop (pyzoo client.py:250-282).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 stream: str = "serving_stream", group: str = "serving",
+                 consumer: Optional[str] = None):
+        from .redis_protocol import RedisClient, RedisError
+        self._RedisClient = RedisClient
+        self._RedisError = RedisError
+        self.host, self.port = host, port
+        self.stream = stream.encode()
+        self.group = group.encode()
+        self.consumer = (consumer or f"cs-{uuid.uuid4().hex[:8]}").encode()
+        # one connection per calling thread: blocking XREADGROUP claims from
+        # one serving worker must not serialize the other workers (or
+        # put_result calls) behind a shared socket lock
+        self._tls = threading.local()
+        self._clients: List = []
+        self._clients_lock = threading.Lock()
+        try:
+            self._conn().execute("XGROUP", "CREATE", self.stream, self.group,
+                                 "0", "MKSTREAM")
+        except RedisError as e:
+            if "BUSYGROUP" not in str(e):
+                raise
+
+    def _conn(self):
+        c = getattr(self._tls, "client", None)
+        if c is None:
+            c = self._RedisClient(self.host, self.port)
+            self._tls.client = c
+            with self._clients_lock:
+                self._clients.append(c)
+        return c
+
+    def enqueue(self, item_id, payload):
+        self._conn().execute("XADD", self.stream, "*",
+                             "uri", item_id, "data", payload)
+
+    def claim_batch(self, max_items, timeout_s):
+        # BLOCK 0 means "block forever" on real Redis — clamp to >=1ms so a
+        # zero/sub-ms timeout stays a poll, matching the other brokers
+        block_ms = max(1, int(timeout_s * 1000))
+        c = self._conn()
+        reply = c.execute(
+            "XREADGROUP", "GROUP", self.group, self.consumer,
+            "COUNT", max_items, "BLOCK", block_ms,
+            "STREAMS", self.stream, ">",
+            timeout_s=timeout_s + 5.0)
+        if not reply:
+            return []
+        batch, ids = [], []
+        for _key, entries in reply:
+            for eid, fields in entries:
+                kv = {fields[i]: fields[i + 1]
+                      for i in range(0, len(fields), 2)}
+                batch.append((kv[b"uri"].decode(), kv[b"data"]))
+                ids.append(eid)
+        if ids:
+            c.execute("XACK", self.stream, self.group, *ids)
+            # trim processed entries so the stream doesn't grow unboundedly
+            # and XLEN keeps meaning "backlog" like the other brokers
+            c.execute("XDEL", self.stream, *ids)
+        return batch
+
+    def put_result(self, item_id, payload):
+        self._conn().execute("HSET", b"result:" + item_id.encode(),
+                             "value", payload)
+
+    def get_result(self, item_id, timeout_s=10.0):
+        key = b"result:" + item_id.encode()
+        c = self._conn()
+        deadline = time.time() + timeout_s
+        while True:
+            val = c.execute("HGET", key, "value")
+            if val is not None:
+                c.execute("DEL", key)
+                return val
+            if time.time() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def pending(self):
+        return int(self._conn().execute("XLEN", self.stream))
+
+    def close(self):
+        with self._clients_lock:
+            clients, self._clients = self._clients, []
+        for c in clients:
+            c.close()
+
+
 def make_broker(spec: str = "memory://serving_stream") -> Broker:
+    """Broker factory: ``memory://<stream>``, ``file://<dir>``, or
+    ``redis://host:port/<stream>`` (stream defaults to serving_stream)."""
     if spec.startswith("memory://"):
         return InMemoryBroker.get(spec[len("memory://"):] or "serving_stream")
     if spec.startswith("file://"):
         return FileBroker(spec[len("file://"):])
-    raise ValueError(f"unknown broker spec {spec} (memory:// or file://)")
+    if spec.startswith("redis://"):
+        rest = spec[len("redis://"):]
+        hostport, _, stream = rest.partition("/")
+        host, _, port = hostport.partition(":")
+        return RedisBroker(host or "127.0.0.1", int(port or 6379),
+                           stream or "serving_stream")
+    raise ValueError(f"unknown broker spec {spec} "
+                     "(memory:// file:// or redis://)")

@@ -31,6 +31,35 @@ def test_arena_alloc_reset():
     a.close()
 
 
+def test_arena_views_pin_native_memory():
+    """Returned arrays keep the Arena (and its native block) alive: GC of
+    the Arena, and even an explicit close(), must not free memory while a
+    view exists (close defers to the last view's death)."""
+    import gc
+    import weakref
+
+    a = Arena(1 << 16)
+    arr = a.alloc_array((16,), np.float32)
+    arr[:] = 5.0
+    ref = weakref.ref(a)
+    a.close()                      # deferred: view still alive
+    del a
+    gc.collect()
+    assert ref() is not None       # pinned through arr.base
+    assert arr.sum() == 80.0       # memory still valid
+    del arr
+    gc.collect()
+    assert ref() is None           # freed once the last view died
+
+
+def test_arena_rejects_alloc_after_close():
+    a = Arena(1 << 16)
+    a.close()
+    if a._lib:  # native path only; numpy fallback has no close semantics
+        with pytest.raises(RuntimeError):
+            a.alloc_array((4,), np.float32)
+
+
 def test_shuffled_indices_deterministic_permutation():
     a = shuffled_indices(1000, seed=42)
     b = shuffled_indices(1000, seed=42)
