@@ -140,7 +140,11 @@ class Arena:
         self.capacity = capacity
         self._live_views = 0
         self._close_requested = False
-        self._state_lock = threading.Lock()
+        # RLock: cyclic GC can fire a view finalizer (_on_view_dead) in the
+        # SAME thread while it holds this lock inside alloc_array — a plain
+        # Lock would self-deadlock. Reentrancy is safe: close() can't sneak
+        # in (it needs this lock), so the arena can't be destroyed mid-alloc.
+        self._state_lock = threading.RLock()
         if self._lib:
             self._h = self._lib.za_arena_create(capacity)
             if not self._h:
@@ -159,13 +163,20 @@ class Arena:
                 ptr = self._lib.za_arena_alloc(self._h, nbytes, align)
                 if not ptr:
                     raise MemoryError("arena exhausted")
-                buf = (ctypes.c_char * nbytes).from_address(ptr)
-                # the array's .base chain ends at `buf`; pinning the Arena on
-                # it keeps the native block alive while any view exists
-                buf._zoo_arena = self
                 self._live_views += 1
+            # Python-object construction happens OUTSIDE the critical
+            # section (it can trigger GC → view finalizers); the count is
+            # already reserved, so a concurrent close() stays deferred.
+            try:
+                buf = (ctypes.c_char * nbytes).from_address(ptr)
+                # the array's .base chain ends at `buf`; pinning the Arena
+                # on it keeps the native block alive while any view exists
+                buf._zoo_arena = self
                 weakref.finalize(buf, self._on_view_dead)
-            return np.frombuffer(buf, dtype=dtype).reshape(shape)
+                return np.frombuffer(buf, dtype=dtype).reshape(shape)
+            except BaseException:
+                self._on_view_dead()  # roll back the reservation
+                raise
         return np.empty(shape, dtype)
 
     def _on_view_dead(self):

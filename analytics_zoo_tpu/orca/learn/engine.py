@@ -76,6 +76,10 @@ class TrainEngine:
         self.extra_vars: Dict[str, Any] = {}
         self.opt_state = None
         self.step = 0
+        # PartitionSpec tree (aligned with unboxed params) when the module
+        # declares tensor-parallel shardings via nn.with_partitioning —
+        # see parallel/tensor_parallel.py
+        self._tp_specs = None
         self._repl = NamedSharding(mesh, P())
         self._jit_train = None
         self._jit_eval = None
@@ -125,6 +129,7 @@ class TrainEngine:
         variables = self._init_vars(rng, small)
         variables = dict(variables)
         params = variables.pop("params")
+        params, variables = self._capture_tp_specs(params, variables)
         self.params = jax.device_put(params, self._param_sharding(params))
         self.extra_vars = jax.device_put(
             variables, jax.tree.map(lambda _: self._repl, variables))
@@ -142,6 +147,33 @@ class TrainEngine:
         return self.module.init(
             {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
             *small_x, **kwargs)
+
+    def _capture_tp_specs(self, params, variables):
+        """If any param carries flax partitioning metadata (the TP layers in
+        parallel/tensor_parallel.py declare their Megatron column/row specs
+        that way), record the PartitionSpec tree and unbox — the engine then
+        works with plain arrays and the specs drive NamedShardings; GSPMD
+        inserts the tp collectives."""
+        import flax.linen as nn
+
+        def boxed(tree):
+            return any(isinstance(l, nn.Partitioned) for l in
+                       jax.tree.leaves(tree, is_leaf=lambda x: isinstance(
+                           x, nn.Partitioned)))
+
+        if boxed(params):
+            self._tp_specs = nn.get_partition_spec(params)
+            params = nn.unbox(params)
+        if boxed(variables):
+            variables = nn.unbox(variables)
+        return params, variables
+
+    def _leaf_sharding(self, leaf, spec) -> NamedSharding:
+        if spec is not None and any(a is not None for a in spec):
+            return NamedSharding(self.mesh, spec)
+        if self.fsdp_params:
+            return self._leaf_fsdp_sharding(leaf)
+        return self._repl
 
     def _leaf_fsdp_sharding(self, leaf) -> NamedSharding:
         """ZeRO-style sharding rule: split the largest dim divisible by the
@@ -162,14 +194,62 @@ class TrainEngine:
         return self._repl
 
     def _param_sharding(self, params):
+        if self._tp_specs is not None:
+            try:
+                from jax.sharding import PartitionSpec
+                return jax.tree.map(
+                    self._leaf_sharding, params, self._tp_specs,
+                    is_leaf=lambda x: x is None or isinstance(x,
+                                                              PartitionSpec))
+            except ValueError:
+                pass  # structure mismatch (foreign tree) → default rules
         if self.fsdp_params:
             return jax.tree.map(self._leaf_fsdp_sharding, params)
         return jax.tree.map(lambda _: self._repl, params)
 
+    @staticmethod
+    def _path_names(path) -> Tuple:
+        return tuple(getattr(k, "key", getattr(k, "name", getattr(k, "idx",
+                                                                  None)))
+                     for k in path)
+
     def _opt_sharding(self, opt_state):
         """Optimizer moments share the param sharding rule (same leaf
-        shapes); scalars/counters replicate."""
-        return self._param_sharding(opt_state)
+        shapes). With TP specs, each opt leaf whose tree path ends with a
+        full param path (optax moments embed the entire params tree) adopts
+        that param's sharding; counters/scalars fall through to the default
+        rules."""
+        if self._tp_specs is None or self.params is None:
+            return self._param_sharding_default(opt_state)
+        shapes = {self._path_names(p): getattr(l, "shape", None)
+                  for p, l in jax.tree_util.tree_flatten_with_path(
+                      self.params)[0]}
+        param_sh = {
+            self._path_names(path): sh
+            for path, sh in jax.tree_util.tree_flatten_with_path(
+                self._param_sharding(self.params))[0]}
+
+        def rule(path, leaf):
+            names = self._path_names(path)
+            for start in range(len(names)):
+                key = names[start:]
+                sh = param_sh.get(key)
+                if sh is not None:
+                    # factored optimizers (adafactor) keep reduced-shape
+                    # state at param paths — only adopt the param's sharding
+                    # when the leaf actually has the param's shape
+                    if getattr(leaf, "shape", None) == shapes.get(key):
+                        return sh
+                    break
+            return (self._leaf_fsdp_sharding(leaf) if self.fsdp_params
+                    else self._repl)
+
+        return jax.tree_util.tree_map_with_path(rule, opt_state)
+
+    def _param_sharding_default(self, tree):
+        if self.fsdp_params:
+            return jax.tree.map(self._leaf_fsdp_sharding, tree)
+        return jax.tree.map(lambda _: self._repl, tree)
 
     # --- model application --------------------------------------------------
     def _apply(self, params, extra, x, train: bool, rng=None):
@@ -270,9 +350,14 @@ class TrainEngine:
         return {"params": jax.device_get(self.params),
                 "extra_vars": jax.device_get(self.extra_vars),
                 "opt_state": jax.device_get(self.opt_state),
-                "step": self.step}
+                "step": self.step,
+                # PartitionSpecs ride along so a fresh engine restoring this
+                # checkpoint re-shards TP params instead of replicating them
+                "tp_specs": self._tp_specs}
 
     def set_state(self, state: Dict[str, Any]):
+        if state.get("tp_specs") is not None:
+            self._tp_specs = state["tp_specs"]
         self.params = jax.device_put(
             state["params"], self._param_sharding(state["params"]))
         self.extra_vars = jax.device_put(

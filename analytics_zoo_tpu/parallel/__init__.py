@@ -1,0 +1,8 @@
+from .mesh import (batch_divisor, create_mesh, data_sharding,
+                   mesh_axis_size, replicated, resolve_axis_sizes)
+from .tensor_parallel import (TPDense, TPMLP, TPSelfAttention,
+                              TPTransformerBlock)
+
+__all__ = ["create_mesh", "data_sharding", "replicated", "resolve_axis_sizes",
+           "mesh_axis_size", "batch_divisor", "TPDense", "TPMLP",
+           "TPSelfAttention", "TPTransformerBlock"]

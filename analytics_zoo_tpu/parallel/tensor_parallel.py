@@ -1,0 +1,149 @@
+"""Tensor parallelism over the ``tp`` mesh axis.
+
+The reference scales a single layer only by data parallelism (its five
+backends all replicate the model; SURVEY.md §2.3-2.4). On TPU, tensor
+parallelism is a first-class axis: Megatron-style column/row-parallel linear
+layers (arXiv:1909.08053) expressed the GSPMD way — parameters carry
+``flax.linen.with_partitioning`` metadata naming mesh axes, the engine turns
+that metadata into ``NamedSharding``s (TrainEngine._param_sharding), and
+XLA's SPMD partitioner inserts the all-gathers/all-reduces over ICI. No
+manual collectives, and the same module runs unmodified on a tp=1 mesh.
+
+Layer recipe (the Megatron pairing):
+
+* ``TPDense(mode="column")`` — kernel split on the OUTPUT dim. Each tp shard
+  computes a slice of the features; activations come out tp-sharded on the
+  feature dim. Bias is sharded the same way.
+* ``TPDense(mode="row")`` — kernel split on the INPUT dim. Consumes
+  tp-sharded activations; XLA all-reduces the partial products. Bias is
+  replicated (added after the reduce).
+* ``TPMLP`` — column → gelu → row: one all-reduce per MLP, activations never
+  materialize unsharded at the hidden width.
+* ``TPSelfAttention`` — fused qkv projection column-split (= heads split
+  across tp shards), output projection row-split. Heads must divide tp.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+default_kernel_init = nn.initializers.lecun_normal()
+
+
+class TPDense(nn.Module):
+    """Column- or row-parallel linear layer (see module docstring)."""
+
+    features: int
+    mode: str = "column"                # "column" | "row"
+    axis: str = "tp"
+    use_bias: bool = True
+    activation: Optional[Callable] = None
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = default_kernel_init
+
+    @nn.compact
+    def __call__(self, x):
+        if self.mode not in ("column", "row"):
+            raise ValueError(f"mode must be column|row, got {self.mode!r}")
+        in_features = x.shape[-1]
+        kspec = ((None, self.axis) if self.mode == "column"
+                 else (self.axis, None))
+        kernel = self.param(
+            "kernel", nn.with_partitioning(self.kernel_init, kspec),
+            (in_features, self.features))
+        x = x.astype(self.dtype) if self.dtype else x
+        y = x @ kernel.astype(x.dtype)
+        if self.use_bias:
+            bspec = (self.axis,) if self.mode == "column" else (None,)
+            bias = self.param(
+                "bias", nn.with_partitioning(nn.initializers.zeros_init(),
+                                             bspec),
+                (self.features,))
+            y = y + bias.astype(y.dtype)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class TPMLP(nn.Module):
+    """Transformer MLP block: column-parallel expand, row-parallel project.
+
+    The hidden activation stays tp-sharded; exactly one all-reduce (inserted
+    by GSPMD after the row matmul) per call.
+    """
+
+    hidden_dim: int
+    out_dim: Optional[int] = None
+    axis: str = "tp"
+    activation: Callable = nn.gelu
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        out_dim = self.out_dim or x.shape[-1]
+        h = TPDense(self.hidden_dim, mode="column", axis=self.axis,
+                    activation=self.activation, dtype=self.dtype,
+                    name="fc_in")(x)
+        return TPDense(out_dim, mode="row", axis=self.axis, dtype=self.dtype,
+                       name="fc_out")(h)
+
+
+class TPSelfAttention(nn.Module):
+    """Multi-head self-attention with heads split across the tp axis.
+
+    Fused qkv projection is column-parallel (each shard owns
+    ``num_heads / tp`` full heads), attention math is embarrassingly parallel
+    per head, and the output projection is row-parallel — the canonical
+    Megatron attention sharding, expressed purely through param metadata.
+    """
+
+    num_heads: int
+    head_dim: Optional[int] = None
+    axis: str = "tp"
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d_model = x.shape[-1]
+        head_dim = self.head_dim or d_model // self.num_heads
+        inner = self.num_heads * head_dim
+
+        qkv = TPDense(3 * inner, mode="column", axis=self.axis,
+                      dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(*t.shape[:-1], self.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scale = head_dim ** -0.5
+        logits = jnp.einsum("...qhd,...khd->...hqk", q * scale, k)
+        if mask is not None:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        probs = nn.softmax(logits)
+        ctx = jnp.einsum("...hqk,...khd->...qhd", probs, v)
+        ctx = ctx.reshape(*ctx.shape[:-2], inner)
+        return TPDense(d_model, mode="row", axis=self.axis, dtype=self.dtype,
+                       name="out")(ctx)
+
+
+class TPTransformerBlock(nn.Module):
+    """Pre-LN transformer block wired from the TP pieces: 2 all-reduces per
+    layer (one after attention out-proj, one after the MLP row matmul)."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    axis: str = "tp"
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = nn.LayerNorm(name="ln1")(x)
+        x = x + TPSelfAttention(self.num_heads, axis=self.axis,
+                                dtype=self.dtype, name="attn")(h, mask)
+        h = nn.LayerNorm(name="ln2")(x)
+        return x + TPMLP(self.mlp_ratio * x.shape[-1], out_dim=x.shape[-1],
+                         axis=self.axis, dtype=self.dtype, name="mlp")(h)

@@ -172,7 +172,8 @@ class RedisBroker(Broker):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  stream: str = "serving_stream", group: str = "serving",
-                 consumer: Optional[str] = None):
+                 consumer: Optional[str] = None,
+                 claim_idle_ms: int = 30000):
         from .redis_protocol import RedisClient, RedisError
         self._RedisClient = RedisClient
         self._RedisError = RedisError
@@ -186,6 +187,13 @@ class RedisBroker(Broker):
         self._tls = threading.local()
         self._clients: List = []
         self._clients_lock = threading.Lock()
+        # stale-pending recovery: a consumer that died between XREADGROUP
+        # and XACK leaves its entries in the group PEL forever (they are
+        # past the group's last-delivered id, so '>' never re-delivers).
+        # Periodic XAUTOCLAIM steals entries idle >= claim_idle_ms back to
+        # a live consumer, restoring at-least-once delivery.
+        self._claim_idle_ms = claim_idle_ms
+        self._last_autoclaim = 0.0
         try:
             self._conn().execute("XGROUP", "CREATE", self.stream, self.group,
                                  "0", "MKSTREAM")
@@ -211,20 +219,35 @@ class RedisBroker(Broker):
         # zero/sub-ms timeout stays a poll, matching the other brokers
         block_ms = max(1, int(timeout_s * 1000))
         c = self._conn()
-        reply = c.execute(
-            "XREADGROUP", "GROUP", self.group, self.consumer,
-            "COUNT", max_items, "BLOCK", block_ms,
-            "STREAMS", self.stream, ">",
-            timeout_s=timeout_s + 5.0)
-        if not reply:
-            return []
         batch, ids = [], []
-        for _key, entries in reply:
-            for eid, fields in entries:
-                kv = {fields[i]: fields[i + 1]
-                      for i in range(0, len(fields), 2)}
-                batch.append((kv[b"uri"].decode(), kv[b"data"]))
-                ids.append(eid)
+        now = time.time()
+        if now - self._last_autoclaim > self._claim_idle_ms / 2000.0:
+            self._last_autoclaim = now
+            try:
+                stolen = c.execute(
+                    "XAUTOCLAIM", self.stream, self.group, self.consumer,
+                    self._claim_idle_ms, "0-0", "COUNT", max_items)
+                for eid, fields in (stolen[1] if stolen else []):
+                    kv = {fields[i]: fields[i + 1]
+                          for i in range(0, len(fields), 2)}
+                    batch.append((kv[b"uri"].decode(), kv[b"data"]))
+                    ids.append(eid)
+            except self._RedisError:
+                pass  # pre-6.2 Redis has no XAUTOCLAIM; skip recovery
+        if not batch:
+            reply = c.execute(
+                "XREADGROUP", "GROUP", self.group, self.consumer,
+                "COUNT", max_items, "BLOCK", block_ms,
+                "STREAMS", self.stream, ">",
+                timeout_s=timeout_s + 5.0)
+            if not reply:
+                return []
+            for _key, entries in reply:
+                for eid, fields in entries:
+                    kv = {fields[i]: fields[i + 1]
+                          for i in range(0, len(fields), 2)}
+                    batch.append((kv[b"uri"].decode(), kv[b"data"]))
+                    ids.append(eid)
         if ids:
             c.execute("XACK", self.stream, self.group, *ids)
             # trim processed entries so the stream doesn't grow unboundedly

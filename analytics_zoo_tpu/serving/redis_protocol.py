@@ -312,8 +312,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     window = s.entries[g["next"]:g["next"] + take]
                     ents = [e for e in window if e is not None]
                     g["next"] += take
+                    now = time.time()
                     for eid, _ in ents:
-                        g["pel"][eid] = consumer
+                        g["pel"][eid] = (consumer, now)
                     reply = [[key, [[eid, f] for eid, f in ents]]]
                     break
                 if deadline is not None and time.time() >= deadline:
@@ -326,6 +327,38 @@ class _Handler(socketserver.BaseRequestHandler):
             self._send(error)
         else:
             self._array(reply)
+
+    def _cmd_xautoclaim(self, st, args):
+        # XAUTOCLAIM key group consumer min-idle-time start [COUNT n]
+        key, group, consumer, min_idle_ms = args[0], args[1], args[2], \
+            int(args[3])
+        count = 100
+        rest = args[5:]
+        for i, a in enumerate(rest):
+            if a.upper() == b"COUNT":
+                count = int(rest[i + 1])
+        claimed = []
+        with st.cv:
+            s = st.streams.get(key)
+            g = s.groups.get(group) if s else None
+            if g is None:
+                pass
+            else:
+                now = time.time()
+                by_id = {e[0]: e[1] for e in s.entries if e is not None}
+                for eid in list(g["pel"]):
+                    owner, t = g["pel"][eid]
+                    if (now - t) * 1000 < min_idle_ms:
+                        continue
+                    fields = by_id.get(eid)
+                    if fields is None:      # XDELed while pending
+                        del g["pel"][eid]
+                        continue
+                    g["pel"][eid] = (consumer, now)
+                    claimed.append([eid, fields])
+                    if len(claimed) >= count:
+                        break
+        self._array([b"0-0", claimed])
 
     def _cmd_xdel(self, st, args):
         """Tombstone entries, then drop the consumed prefix (the broker XDELs

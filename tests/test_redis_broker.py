@@ -127,6 +127,29 @@ def test_block_zero_is_poll_not_forever(mini_redis):
     broker.close()
 
 
+def test_stale_pending_entries_recovered(mini_redis):
+    """A consumer that claims entries but dies before processing leaves them
+    in the group PEL; another consumer's periodic XAUTOCLAIM must steal and
+    redeliver them (at-least-once)."""
+    dead = RedisBroker(mini_redis.host, mini_redis.port, stream="pel")
+    dead.enqueue("lost-1", b"a")
+    dead.enqueue("lost-2", b"b")
+    # simulate dying between XREADGROUP and XACK: read without acking
+    c = dead._conn()
+    c.execute("XREADGROUP", "GROUP", dead.group, b"dead-consumer",
+              "COUNT", "10", "BLOCK", "100", "STREAMS", dead.stream, ">")
+    # '>' never re-delivers these now
+    assert dead.claim_batch(10, timeout_s=0.1) == []
+
+    live = RedisBroker(mini_redis.host, mini_redis.port, stream="pel",
+                       claim_idle_ms=1)  # everything counts as stale
+    time.sleep(0.01)
+    got = live.claim_batch(10, timeout_s=0.5)
+    assert sorted(i for i, _ in got) == ["lost-1", "lost-2"]
+    dead.close()
+    live.close()
+
+
 def test_make_broker_redis_uri(mini_redis):
     b = make_broker(f"redis://{mini_redis.host}:{mini_redis.port}/uristream")
     b.enqueue("x", b"1")
