@@ -148,6 +148,12 @@ class ObjectDetector(ZooModel):
                     max_detections=max_detections)
 
         engine = self.estimator.engine
+        if engine.params is None:
+            # never trained (serving a freshly constructed net, or before
+            # load_model): initialize params so the servable is well-formed
+            sample = np.zeros((1, self.image_size, self.image_size, 3),
+                              np.float32)
+            engine.build((sample,))
         variables = {"params": engine.params, **engine.extra_vars}
         return InferenceModel().load_jax(_Servable(), variables)
 

@@ -129,12 +129,20 @@ def _pair(v):
 
 def build_flax_from_torch(module):
     """Return (flax_module, param_loader) where param_loader(variables)
-    overwrites initialized variables with the torch weights."""
+    overwrites initialized variables with the torch weights.
+
+    Sequential-style modules take the NHWC fast path below; anything with a
+    custom ``forward()`` falls through to the torch.fx graph tracer
+    (fx_bridge.py), which handles residuals/concats/reshapes generally."""
     import flax.linen as fnn
     import jax.numpy as jnp
 
-    specs = tuple((tuple(sorted(s.items(), key=lambda kv: kv[0])))
-                  for s in _op_specs_from_torch(module))
+    try:
+        specs = tuple((tuple(sorted(s.items(), key=lambda kv: kv[0])))
+                      for s in _op_specs_from_torch(module))
+    except TorchConversionError:
+        from .fx_bridge import build_flax_from_torch_fx
+        return build_flax_from_torch_fx(module)
     spec_dicts = [dict(s) for s in specs]
 
     class TorchConverted(fnn.Module):

@@ -25,70 +25,84 @@ class KerasConversionError(ValueError):
     pass
 
 
-def _layer_specs(model) -> List[Dict[str, Any]]:
+_MERGE_KINDS = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
+                "Average": "avg", "Maximum": "max", "Minimum": "min",
+                "Concatenate": "concat"}
+
+
+def _spec_for(lyr) -> Optional[Dict[str, Any]]:
+    """Spec dict for one keras layer; None for InputLayer; raises for
+    unsupported types."""
     import tensorflow as tf
     K = tf.keras.layers
+    cfg = lyr.get_config()
+    if isinstance(lyr, K.InputLayer):
+        return None
+    tname = type(lyr).__name__
+    if tname in _MERGE_KINDS:
+        return {"kind": "merge", "op": _MERGE_KINDS[tname],
+                "axis": cfg.get("axis", -1), "name": lyr.name}
+    if isinstance(lyr, K.Dense):
+        return {"kind": "dense", "units": cfg["units"],
+                "activation": cfg.get("activation"),
+                "use_bias": cfg.get("use_bias", True), "name": lyr.name}
+    if isinstance(lyr, K.Conv2D):
+        return {"kind": "conv2d", "filters": cfg["filters"],
+                "kernel": tuple(cfg["kernel_size"]),
+                "strides": tuple(cfg["strides"]),
+                "padding": cfg["padding"].upper(),
+                "activation": cfg.get("activation"),
+                "use_bias": cfg.get("use_bias", True), "name": lyr.name}
+    if isinstance(lyr, K.BatchNormalization):
+        return {"kind": "batchnorm", "eps": cfg["epsilon"],
+                "momentum": cfg["momentum"], "name": lyr.name}
+    if isinstance(lyr, K.LayerNormalization):
+        return {"kind": "layernorm", "eps": cfg["epsilon"], "name": lyr.name}
+    if isinstance(lyr, K.Dropout):
+        return {"kind": "dropout", "rate": cfg["rate"], "name": lyr.name}
+    if isinstance(lyr, K.Flatten):
+        return {"kind": "flatten", "name": lyr.name}
+    if isinstance(lyr, K.MaxPooling2D):
+        return {"kind": "maxpool", "pool": tuple(cfg["pool_size"]),
+                "strides": tuple(cfg["strides"] or cfg["pool_size"]),
+                "padding": cfg["padding"].upper(), "name": lyr.name}
+    if isinstance(lyr, K.AveragePooling2D):
+        return {"kind": "avgpool", "pool": tuple(cfg["pool_size"]),
+                "strides": tuple(cfg["strides"] or cfg["pool_size"]),
+                "padding": cfg["padding"].upper(), "name": lyr.name}
+    if isinstance(lyr, K.GlobalAveragePooling2D):
+        return {"kind": "globalavgpool", "name": lyr.name}
+    if isinstance(lyr, K.Embedding):
+        return {"kind": "embedding", "num": cfg["input_dim"],
+                "dim": cfg["output_dim"], "name": lyr.name}
+    if isinstance(lyr, K.Activation):
+        return {"kind": "act", "fn": cfg["activation"], "name": lyr.name}
+    if isinstance(lyr, K.ReLU):
+        return {"kind": "act", "fn": "relu", "name": lyr.name}
+    if isinstance(lyr, K.Softmax):
+        return {"kind": "act", "fn": "softmax", "name": lyr.name}
+    raise KerasConversionError(
+        f"unsupported keras layer {type(lyr).__name__} ('{lyr.name}')."
+        " Supported: Dense/Conv2D/BN/LN/Dropout/Flatten/pooling/"
+        "Embedding/Activation/Add/Concatenate and friends. For custom "
+        "layers, write the model as a flax module (see analytics_zoo_tpu."
+        "models) and use Estimator.from_keras(model=flax_module).")
 
+
+def _layer_specs(model) -> List[Dict[str, Any]]:
     layers = getattr(model, "layers", None)
     if layers is None:
         raise KerasConversionError("expected a keras Model")
-    # verify linear topology for functional models
     specs: List[Dict[str, Any]] = []
     for lyr in layers:
-        cfg = lyr.get_config()
-        if isinstance(lyr, K.InputLayer):
+        s = _spec_for(lyr)
+        if s is None:
             continue
-        if isinstance(lyr, K.Dense):
-            specs.append({"kind": "dense", "units": cfg["units"],
-                          "activation": cfg.get("activation"),
-                          "use_bias": cfg.get("use_bias", True),
-                          "name": lyr.name})
-        elif isinstance(lyr, K.Conv2D):
-            specs.append({"kind": "conv2d", "filters": cfg["filters"],
-                          "kernel": tuple(cfg["kernel_size"]),
-                          "strides": tuple(cfg["strides"]),
-                          "padding": cfg["padding"].upper(),
-                          "activation": cfg.get("activation"),
-                          "use_bias": cfg.get("use_bias", True),
-                          "name": lyr.name})
-        elif isinstance(lyr, K.BatchNormalization):
-            specs.append({"kind": "batchnorm", "eps": cfg["epsilon"],
-                          "momentum": cfg["momentum"], "name": lyr.name})
-        elif isinstance(lyr, K.LayerNormalization):
-            specs.append({"kind": "layernorm", "eps": cfg["epsilon"],
-                          "name": lyr.name})
-        elif isinstance(lyr, K.Dropout):
-            specs.append({"kind": "dropout", "rate": cfg["rate"],
-                          "name": lyr.name})
-        elif isinstance(lyr, K.Flatten):
-            specs.append({"kind": "flatten", "name": lyr.name})
-        elif isinstance(lyr, K.MaxPooling2D):
-            specs.append({"kind": "maxpool", "pool": tuple(cfg["pool_size"]),
-                          "strides": tuple(cfg["strides"] or cfg["pool_size"]),
-                          "padding": cfg["padding"].upper(), "name": lyr.name})
-        elif isinstance(lyr, K.AveragePooling2D):
-            specs.append({"kind": "avgpool", "pool": tuple(cfg["pool_size"]),
-                          "strides": tuple(cfg["strides"] or cfg["pool_size"]),
-                          "padding": cfg["padding"].upper(), "name": lyr.name})
-        elif isinstance(lyr, K.GlobalAveragePooling2D):
-            specs.append({"kind": "globalavgpool", "name": lyr.name})
-        elif isinstance(lyr, K.Embedding):
-            specs.append({"kind": "embedding", "num": cfg["input_dim"],
-                          "dim": cfg["output_dim"], "name": lyr.name})
-        elif isinstance(lyr, K.Activation):
-            specs.append({"kind": "act", "fn": cfg["activation"],
-                          "name": lyr.name})
-        elif isinstance(lyr, K.ReLU):
-            specs.append({"kind": "act", "fn": "relu", "name": lyr.name})
-        elif isinstance(lyr, K.Softmax):
-            specs.append({"kind": "act", "fn": "softmax", "name": lyr.name})
-        else:
+        if s["kind"] == "merge":
             raise KerasConversionError(
-                f"unsupported keras layer {type(lyr).__name__} ('{lyr.name}')."
-                " Supported: Dense/Conv2D/BN/LN/Dropout/Flatten/pooling/"
-                "Embedding/Activation. For custom layers or branching graphs,"
-                " write the model as a flax module (see analytics_zoo_tpu."
-                "models) and use Estimator.from_keras(model=flax_module).")
+                f"merge layer '{lyr.name}' in a Sequential walk — use the "
+                "functional graph path")
+        specs.append(s)
     return specs
 
 
@@ -109,10 +123,87 @@ def _apply_act(x, fn: Optional[str]):
     return getattr(jax.nn, fn)(x)
 
 
-def build_flax_from_keras(model):
-    """Return (flax_module, param_loader(variables)->variables)."""
+def _run_spec(s: Dict[str, Any], xs: list, nm: str, train: bool):
+    """Apply one layer spec to its inputs. Must be called from inside a
+    flax compact __call__ (submodules register against the caller)."""
     import flax.linen as fnn
     import jax.numpy as jnp
+
+    k = s["kind"]
+    x = xs[0]
+    if k == "merge":
+        op = s["op"]
+        if op == "concat":
+            return jnp.concatenate(xs, axis=s.get("axis", -1))
+        if op == "add":
+            return sum(xs[1:], xs[0])
+        if op == "sub":
+            return xs[0] - xs[1]
+        if op == "mul":
+            out = xs[0]
+            for o in xs[1:]:
+                out = out * o
+            return out
+        if op == "avg":
+            return sum(xs[1:], xs[0]) / len(xs)
+        if op == "max":
+            out = xs[0]
+            for o in xs[1:]:
+                out = jnp.maximum(out, o)
+            return out
+        if op == "min":
+            out = xs[0]
+            for o in xs[1:]:
+                out = jnp.minimum(out, o)
+            return out
+    if k == "dense":
+        x = fnn.Dense(s["units"], use_bias=s["use_bias"], name=nm)(x)
+        return _apply_act(x, s.get("activation"))
+    if k == "conv2d":
+        x = fnn.Conv(s["filters"], s["kernel"], s["strides"],
+                     padding=s["padding"], use_bias=s["use_bias"],
+                     name=nm)(x)
+        return _apply_act(x, s.get("activation"))
+    if k == "batchnorm":
+        return fnn.BatchNorm(use_running_average=not train,
+                             momentum=s["momentum"], epsilon=s["eps"],
+                             name=nm)(x)
+    if k == "layernorm":
+        return fnn.LayerNorm(epsilon=s["eps"], name=nm)(x)
+    if k == "dropout":
+        return fnn.Dropout(rate=s["rate"], deterministic=not train,
+                           name=nm)(x)
+    if k == "flatten":
+        return x.reshape(x.shape[0], -1)
+    if k == "maxpool":
+        return fnn.max_pool(x, s["pool"], s["strides"], s["padding"])
+    if k == "avgpool":
+        return fnn.avg_pool(x, s["pool"], s["strides"], s["padding"])
+    if k == "globalavgpool":
+        return x.mean(axis=(1, 2))
+    if k == "embedding":
+        return fnn.Embed(s["num"], s["dim"], name=nm)(x.astype(jnp.int32))
+    if k == "act":
+        return _apply_act(x, s["fn"])
+    raise KerasConversionError(f"unhandled spec kind {k}")
+
+
+def build_flax_from_keras(model):
+    """Return (flax_module, param_loader(variables)->variables).
+
+    Sequential models (and models whose get_config has no graph topology)
+    use the linear chain below; Functional models go through the DAG
+    interpreter (build_flax_from_keras_graph), which supports branching and
+    merge layers (Add/Concatenate/...)."""
+    import flax.linen as fnn
+
+    cfg = {}
+    try:
+        cfg = model.get_config()
+    except Exception:
+        pass
+    if isinstance(cfg, dict) and "input_layers" in cfg:
+        return build_flax_from_keras_graph(model, cfg)
 
     specs = _layer_specs(model)
 
@@ -120,78 +211,138 @@ def build_flax_from_keras(model):
         @fnn.compact
         def __call__(self, x, train: bool = False):
             for i, s in enumerate(specs):
-                k, nm = s["kind"], f"op_{i}"
-                if k == "dense":
-                    x = fnn.Dense(s["units"], use_bias=s["use_bias"],
-                                  name=nm)(x)
-                    x = _apply_act(x, s.get("activation"))
-                elif k == "conv2d":
-                    x = fnn.Conv(s["filters"], s["kernel"], s["strides"],
-                                 padding=s["padding"],
-                                 use_bias=s["use_bias"], name=nm)(x)
-                    x = _apply_act(x, s.get("activation"))
-                elif k == "batchnorm":
-                    x = fnn.BatchNorm(use_running_average=not train,
-                                      momentum=s["momentum"],
-                                      epsilon=s["eps"], name=nm)(x)
-                elif k == "layernorm":
-                    x = fnn.LayerNorm(epsilon=s["eps"], name=nm)(x)
-                elif k == "dropout":
-                    x = fnn.Dropout(rate=s["rate"], deterministic=not train,
-                                    name=nm)(x)
-                elif k == "flatten":
-                    x = x.reshape(x.shape[0], -1)
-                elif k == "maxpool":
-                    x = fnn.max_pool(x, s["pool"], s["strides"], s["padding"])
-                elif k == "avgpool":
-                    x = fnn.avg_pool(x, s["pool"], s["strides"], s["padding"])
-                elif k == "globalavgpool":
-                    x = x.mean(axis=(1, 2))
-                elif k == "embedding":
-                    x = fnn.Embed(s["num"], s["dim"], name=nm)(
-                        x.astype(jnp.int32))
-                elif k == "act":
-                    x = _apply_act(x, s["fn"])
+                x = _run_spec(s, [x], f"op_{i}", train)
             return x
 
-    weights = {}
-    for lyr in model.layers:
-        try:
-            weights[lyr.name] = [np.asarray(w) for w in lyr.get_weights()]
-        except Exception:
-            weights[lyr.name] = []
+    pairs = [(s, f"op_{i}") for i, s in enumerate(specs)]
+    return KerasConverted(), _make_loader(_snapshot_weights(model), pairs)
+
+
+def _make_loader(weights: Dict[str, list], pairs):
+    """Shared weight loader: ``pairs`` is [(spec, flax_name), ...]."""
 
     def load_params(variables):
         import jax
         variables = jax.tree.map(np.asarray, jax.device_get(variables))
         params = dict(variables.get("params", {}))
         batch_stats = dict(variables.get("batch_stats", {}))
-        for i, s in enumerate(specs):
-            nm, k = f"op_{i}", s["kind"]
-            w = weights.get(s["name"], [])
-            if not w:
-                continue
-            if k == "dense":
-                params[nm] = {"kernel": w[0]}
-                if s["use_bias"] and len(w) > 1:
-                    params[nm]["bias"] = w[1]
-            elif k == "conv2d":
-                params[nm] = {"kernel": w[0]}
-                if s["use_bias"] and len(w) > 1:
-                    params[nm]["bias"] = w[1]
-            elif k == "batchnorm":
-                params[nm] = {"scale": w[0], "bias": w[1]}
-                batch_stats[nm] = {"mean": w[2], "var": w[3]}
-            elif k == "layernorm":
-                params[nm] = {"scale": w[0], "bias": w[1]}
-            elif k == "embedding":
-                params[nm] = {"embedding": w[0]}
+        for s, nm in pairs:
+            _load_spec_weights(params, batch_stats, s, nm,
+                               weights.get(s["name"], []))
         out = {"params": params}
         if batch_stats:
             out["batch_stats"] = batch_stats
         return out
 
-    return KerasConverted(), load_params
+    return load_params
+
+
+def _snapshot_weights(model) -> Dict[str, list]:
+    weights = {}
+    for lyr in model.layers:
+        try:
+            weights[lyr.name] = [np.asarray(w) for w in lyr.get_weights()]
+        except Exception:
+            weights[lyr.name] = []
+    return weights
+
+
+def _load_spec_weights(params, batch_stats, s, nm, w):
+    k = s["kind"]
+    if not w:
+        return
+    if k in ("dense", "conv2d"):
+        params[nm] = {"kernel": w[0]}
+        if s["use_bias"] and len(w) > 1:
+            params[nm]["bias"] = w[1]
+    elif k == "batchnorm":
+        params[nm] = {"scale": w[0], "bias": w[1]}
+        batch_stats[nm] = {"mean": w[2], "var": w[3]}
+    elif k == "layernorm":
+        params[nm] = {"scale": w[0], "bias": w[1]}
+    elif k == "embedding":
+        params[nm] = {"embedding": w[0]}
+
+
+def _parse_inbound(node_cfg) -> List[str]:
+    """Parent layer names from a keras-3 inbound_nodes entry (nested
+    __keras_tensor__ dicts with keras_history) or the legacy nested-list
+    format [[name, node_idx, tensor_idx, {}], ...]."""
+    parents: List[str] = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if obj.get("class_name") == "__keras_tensor__":
+                parents.append(obj["config"]["keras_history"][0])
+            else:
+                for v in obj.values():
+                    walk(v)
+        elif isinstance(obj, (list, tuple)):
+            if (len(obj) >= 3 and isinstance(obj[0], str)
+                    and isinstance(obj[1], int) and isinstance(obj[2], int)):
+                parents.append(obj[0])  # legacy [name, n, t, {}]
+            else:
+                for v in obj:
+                    walk(v)
+
+    walk(node_cfg)
+    return parents
+
+
+def build_flax_from_keras_graph(model, cfg: Optional[dict] = None):
+    """DAG interpreter for Functional keras models: every layer node is
+    re-emitted as flax against its actual parents, so branching topologies
+    and merge layers (Add/Concatenate/...) convert exactly. Multi-input /
+    multi-output models map to ``__call__(*inputs) -> tuple``."""
+    import flax.linen as fnn
+
+    cfg = cfg or model.get_config()
+    entries = []          # (layer_name, spec|None, parent names)
+    for lcfg in cfg["layers"]:
+        name = lcfg.get("name") or lcfg["config"]["name"]
+        lyr = model.get_layer(name)
+        spec = _spec_for(lyr)
+        inbound = lcfg.get("inbound_nodes", [])
+        if spec is not None and len(inbound) > 1:
+            # one env slot per layer name: a layer called at multiple graph
+            # sites (shared weights) would silently merge its parent lists
+            raise KerasConversionError(
+                f"layer '{name}' is called {len(inbound)} times (shared "
+                "weights); the graph converter supports one call site per "
+                "layer — duplicate the layer or port the model to flax")
+        parents = _parse_inbound(inbound)
+        entries.append((name, spec, parents))
+
+    def norm_io(io):
+        # ['name', 0, 0] or [['a',0,0], ['b',0,0]]
+        if io and isinstance(io[0], str):
+            return [io[0]]
+        return [e[0] for e in io]
+
+    input_names = norm_io(cfg["input_layers"])
+    output_names = norm_io(cfg["output_layers"])
+
+    class KerasGraphConverted(fnn.Module):
+        @fnn.compact
+        def __call__(self, *inputs, train: bool = False):
+            if len(inputs) != len(input_names):
+                raise ValueError(
+                    f"model expects {len(input_names)} inputs "
+                    f"({input_names}), got {len(inputs)}")
+            env = dict(zip(input_names, inputs))
+            for name, spec, parents in entries:
+                if spec is None:        # InputLayer
+                    continue
+                xs = [env[p] for p in parents]
+                env[name] = _run_spec(spec, xs, name.replace(".", "_"),
+                                      train)
+            outs = tuple(env[n] for n in output_names)
+            return outs[0] if len(outs) == 1 else outs
+
+    pairs = [(spec, name.replace(".", "_"))
+             for name, spec, _parents in entries if spec is not None]
+    return KerasGraphConverted(), _make_loader(_snapshot_weights(model),
+                                               pairs)
 
 
 def extract_compile_args(model) -> Tuple[Optional[str], Any, list]:

@@ -1,0 +1,5 @@
+from .estimator import BERTClassifier, BERTNER, BERTSQuAD, bert_input_fn
+from .keras import NER, POSTagger, IntentEntity
+
+__all__ = ["BERTClassifier", "BERTNER", "BERTSQuAD", "bert_input_fn",
+           "NER", "POSTagger", "IntentEntity"]

@@ -1,0 +1,160 @@
+"""BERT text estimators — TPU-native equivalents of the reference's
+tfpark.text.estimator family (pyzoo/zoo/tfpark/text/estimator/: bert_base.py
+BERTBaseEstimator over tf.estimator + bert_input_fn, bert_classifier.py,
+bert_ner.py, bert_squad.py).
+
+The reference wraps Google's TF1 BERT checkpoint graph in a tf.estimator and
+ships it through TFEstimator to Spark workers. Here the encoder is the flax
+``BERT`` from the keras pipeline layers (one jitted XLA program, flash
+attention inside), each task adds its head in flax, and training runs on the
+unified TPUEstimator — the public surface (``fit``/``evaluate``/``predict``
+over feature dicts) matches the reference estimators.
+
+Feature dict convention (same keys as the reference's bert_input_fn,
+bert_base.py:30-60): ``input_ids``, optional ``token_type_ids``, optional
+``input_mask``; labels under ``label_ids`` / (``start_positions``,
+``end_positions``) for SQuAD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ...orca.learn.estimator import TPUEstimator
+from ...pipeline.api.keras.layers.self_attention import BERT
+
+
+def bert_input_fn(features: Dict[str, np.ndarray],
+                  labels: Optional[np.ndarray] = None,
+                  batch_size: int = 32) -> Dict[str, Any]:
+    """Assemble the estimator data dict from BERT feature arrays (the
+    reference's bert_input_fn builds a TFDataset the same way)."""
+    ids = np.asarray(features["input_ids"], np.int32)
+    xs = [ids]
+    tt = features.get("token_type_ids", features.get("segment_ids"))
+    mask = features.get("input_mask", features.get("attention_mask"))
+    if tt is not None or mask is not None:
+        # positional convention: (ids, token_type_ids[, input_mask])
+        xs.append(np.asarray(tt, np.int32) if tt is not None
+                  else np.zeros_like(ids))
+    if mask is not None:
+        xs.append(np.asarray(mask, np.int32))
+    data: Dict[str, Any] = {"x": tuple(xs) if len(xs) > 1 else xs[0]}
+    if labels is not None:
+        data["y"] = labels
+    return data
+
+
+class _BertWithHead(nn.Module):
+    """BERT encoder + task head. head: 'pooled' (b,h)->logits over classes,
+    'tokens' per-token logits, 'span' start/end logits."""
+    bert_kwargs: Tuple[Tuple[str, Any], ...]
+    num_out: int
+    head: str = "pooled"
+    head_drop: float = 0.1
+
+    @nn.compact
+    def __call__(self, ids, token_type_ids=None, input_mask=None,
+                 train: bool = False):
+        seq, pooled = BERT(**dict(self.bert_kwargs), name="bert")(
+            ids, token_type_ids, attention_mask=input_mask, train=train)
+        if self.head == "pooled":
+            h = nn.Dropout(self.head_drop, deterministic=not train)(pooled)
+            return nn.Dense(self.num_out, name="head")(h)
+        h = nn.Dropout(self.head_drop, deterministic=not train)(seq)
+        return nn.Dense(self.num_out, name="head")(h)   # (b, s, num_out)
+
+
+class BERTBaseEstimator(TPUEstimator):
+    """Shared constructor surface (reference bert_base.py:125-134:
+    bert_config_file/init_checkpoint/... params). TPU-native: BERT hyper-
+    params are passed directly (or read from a bert_config.json via
+    ``bert_config_file``); ``init_checkpoint`` loads a pickled params tree
+    saved by this framework."""
+
+    def __init__(self, *, num_out: int, head: str,
+                 bert_config: Optional[dict] = None,
+                 bert_config_file: Optional[str] = None,
+                 init_checkpoint: Optional[str] = None,
+                 optimizer="adam", loss=None, metrics=None,
+                 model_dir: Optional[str] = None, **bert_kwargs):
+        if bert_config_file:
+            import json
+            with open(bert_config_file) as f:
+                raw = json.load(f)
+            bert_config = {
+                "vocab": raw.get("vocab_size", 30522),
+                "hidden_size": raw.get("hidden_size", 768),
+                "n_block": raw.get("num_hidden_layers", 12),
+                "n_head": raw.get("num_attention_heads", 12),
+                "seq_len": raw.get("max_position_embeddings", 512),
+                "intermediate_size": raw.get("intermediate_size", 3072),
+                "hidden_p_drop": raw.get("hidden_dropout_prob", 0.1),
+                "attn_p_drop": raw.get(
+                    "attention_probs_dropout_prob", 0.1)}
+        cfg = dict(bert_config or {})
+        cfg.update(bert_kwargs)
+        module = _BertWithHead(
+            bert_kwargs=tuple(sorted(cfg.items())), num_out=num_out,
+            head=head)
+        super().__init__(module, loss=loss, optimizer=optimizer,
+                         metrics=metrics, model_dir=model_dir)
+        if init_checkpoint:
+            self.load(init_checkpoint)
+
+
+class BERTClassifier(BERTBaseEstimator):
+    """Sequence classification on the pooled [CLS] output (reference
+    bert_classifier.py:51: make_bert_classifier_model_fn -> dense over
+    pooled)."""
+
+    def __init__(self, num_classes: int, **kwargs):
+        from functools import partial
+        from ...orca.learn.losses import sparse_categorical_crossentropy
+        kwargs.setdefault("loss", partial(sparse_categorical_crossentropy,
+                                          from_logits=True))
+        kwargs.setdefault("metrics", ["sparse_categorical_accuracy"])
+        super().__init__(num_out=num_classes, head="pooled", **kwargs)
+
+
+class BERTNER(BERTBaseEstimator):
+    """Token-level entity tagging (reference bert_ner.py:51: per-token dense
+    over the sequence output, labels (b, s))."""
+
+    def __init__(self, num_entities: int, **kwargs):
+        from functools import partial
+        from ...orca.learn.losses import sparse_categorical_crossentropy
+        kwargs.setdefault("loss", partial(sparse_categorical_crossentropy,
+                                          from_logits=True))
+        kwargs.setdefault("metrics", None)
+        super().__init__(num_out=num_entities, head="tokens", **kwargs)
+
+
+def _squad_loss(y, logits):
+    """y: (b, 2) start/end token indices; logits: (b, s, 2)."""
+    import jax
+
+    start_logits, end_logits = logits[..., 0], logits[..., 1]
+
+    def ce(pos_logits, pos):
+        logp = jax.nn.log_softmax(pos_logits, axis=-1)
+        return -jnp.take_along_axis(logp, pos[:, None], axis=-1)[:, 0]
+
+    return 0.5 * (ce(start_logits, y[:, 0].astype(jnp.int32)) +
+                  ce(end_logits, y[:, 1].astype(jnp.int32)))
+
+
+class BERTSQuAD(BERTBaseEstimator):
+    """Extractive QA: start/end span logits per token (reference
+    bert_squad.py:56: two-unit dense over sequence output, losses averaged
+    over start+end positions)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("loss", _squad_loss)
+        kwargs.setdefault("metrics", None)
+        super().__init__(num_out=2, head="tokens", **kwargs)

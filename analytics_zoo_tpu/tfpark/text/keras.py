@@ -1,0 +1,208 @@
+"""Keras-style text models — TPU-native equivalents of the reference's
+tfpark.text.keras family (pyzoo/zoo/tfpark/text/keras/: ner.py NER,
+pos_tagging.py POSTagger, intent_extraction.py IntentEntity — all thin
+wrappers over nlp-architect BiLSTM "labor" models).
+
+nlp-architect doesn't exist here; the models are re-implemented as flax
+BiLSTM taggers over word(+char) embeddings, trained by the unified engine:
+
+* ``NER``        — word + char-CNN embeddings -> BiLSTM -> per-token softmax
+  (the reference's NERCRF uses a CRF decode layer; greedy softmax decoding
+  is used instead, which is the usual TPU-friendly simplification).
+* ``POSTagger``  — same skeleton, POS tag inventory.
+* ``IntentEntity`` — joint model: shared BiLSTM, intent head on the final
+  state + slot head per token (intent_extraction.py MultiTaskIntentModel).
+
+Each model exposes fit/evaluate/predict + save/load via its TPUEstimator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...orca.learn.estimator import TPUEstimator
+from ...orca.learn.losses import sparse_categorical_crossentropy
+
+
+def _token_ce(y, logits):
+    """Per-token CE that ignores padding label 0 (tag inventories here
+    reserve 0 = PAD, matching the reference's padded-sentence batches)."""
+    per_tok = sparse_categorical_crossentropy(y, logits, from_logits=True)
+    mask = (y > 0).astype(per_tok.dtype)
+    return (per_tok * mask).sum(-1) / jnp.maximum(mask.sum(-1), 1.0)
+
+
+class _BiLSTM(nn.Module):
+    units: int
+
+    @nn.compact
+    def __call__(self, x):
+        fwd = nn.RNN(nn.LSTMCell(features=self.units), keep_order=True)(x)
+        bwd = nn.RNN(nn.LSTMCell(features=self.units), reverse=True,
+                     keep_order=True)(x)
+        return jnp.concatenate([fwd, bwd], axis=-1)
+
+
+class _TaggerNet(nn.Module):
+    """word ids (b,s) [+ char ids (b,s,w)] -> per-token tag logits."""
+    vocab_size: int
+    num_tags: int
+    word_emb_dim: int = 100
+    char_vocab_size: int = 0
+    char_emb_dim: int = 30
+    lstm_units: int = 100
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, word_ids, char_ids=None, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.word_emb_dim,
+                     name="word_embedding")(word_ids.astype(jnp.int32))
+        if char_ids is not None and self.char_vocab_size:
+            c = nn.Embed(self.char_vocab_size, self.char_emb_dim,
+                         name="char_embedding")(char_ids.astype(jnp.int32))
+            # char-CNN per word: conv over the char axis, max-pool
+            b, s, w, d = c.shape
+            c = nn.Conv(self.char_emb_dim, (3,), name="char_conv")(
+                c.reshape(b * s, w, d))
+            c = c.max(axis=1).reshape(b, s, self.char_emb_dim)
+            h = jnp.concatenate([h, c], axis=-1)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = _BiLSTM(self.lstm_units, name="bilstm")(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return nn.Dense(self.num_tags, name="tag_head")(h)
+
+
+class _Tagger:
+    """Shared estimator wrapper for NER / POSTagger."""
+
+    def __init__(self, num_tags: int, vocab_size: int,
+                 char_vocab_size: int = 0, word_emb_dim: int = 100,
+                 char_emb_dim: int = 30, lstm_units: int = 100,
+                 dropout: float = 0.5, optimizer="adam"):
+        self.module = _TaggerNet(
+            vocab_size=vocab_size, num_tags=num_tags,
+            word_emb_dim=word_emb_dim, char_vocab_size=char_vocab_size,
+            char_emb_dim=char_emb_dim, lstm_units=lstm_units,
+            dropout=dropout)
+        self.estimator = TPUEstimator(self.module, loss=_token_ce,
+                                      optimizer=optimizer)
+
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1, **kw):
+        return self.estimator.fit({"x": x, "y": y}, epochs=epochs,
+                                  batch_size=batch_size, **kw)
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        return self.estimator.evaluate({"x": x, "y": y},
+                                       batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32):
+        logits = self.estimator.predict(x, batch_size=batch_size)
+        return np.argmax(np.asarray(logits), axis=-1)
+
+    def save_model(self, path: str):
+        return self.estimator.save(path)
+
+    def load_model(self, path: str):
+        self.estimator.load(path)
+        return self
+
+
+class NER(_Tagger):
+    """(reference ner.py NER: nlp-architect NERCRF labor)"""
+
+
+class POSTagger(_Tagger):
+    """(reference pos_tagging.py POSTagger)"""
+
+
+class _IntentEntityNet(nn.Module):
+    vocab_size: int
+    num_intents: int
+    num_entities: int
+    word_emb_dim: int = 100
+    lstm_units: int = 100
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, word_ids, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.word_emb_dim,
+                     name="word_embedding")(word_ids.astype(jnp.int32))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = _BiLSTM(self.lstm_units, name="bilstm")(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        intent_logits = nn.Dense(self.num_intents, name="intent_head")(
+            h.mean(axis=1))
+        slot_logits = nn.Dense(self.num_entities, name="slot_head")(h)
+        # fixed-shape packing: (b, 1+s, max(num_intents, num_entities))
+        width = max(self.num_intents, self.num_entities)
+
+        def pad(t):
+            return jnp.pad(t, [(0, 0)] * (t.ndim - 1) +
+                           [(0, width - t.shape[-1])],
+                           constant_values=-1e9)
+
+        return jnp.concatenate([pad(intent_logits)[:, None], pad(slot_logits)],
+                               axis=1)
+
+
+def _intent_entity_loss(num_intents, num_entities):
+    def loss(y, packed):
+        # y: (b, 1+s) — y[:,0] intent id, y[:,1:] slot ids (0 = PAD)
+        intent_logits = packed[:, 0, :num_intents]
+        slot_logits = packed[:, 1:, :num_entities]
+        intent_l = sparse_categorical_crossentropy(
+            y[:, 0], intent_logits, from_logits=True)
+        slot_l = _token_ce(y[:, 1:], slot_logits)
+        return intent_l + slot_l
+    return loss
+
+
+class IntentEntity:
+    """Joint intent + slot model (reference intent_extraction.py
+    MultiTaskIntentModel). Labels pack as (b, 1+s): column 0 = intent id,
+    rest = per-token slot ids (0 = PAD)."""
+
+    def __init__(self, num_intents: int, num_entities: int, vocab_size: int,
+                 word_emb_dim: int = 100, lstm_units: int = 100,
+                 dropout: float = 0.5, optimizer="adam"):
+        self.num_intents = num_intents
+        self.num_entities = num_entities
+        self.module = _IntentEntityNet(
+            vocab_size=vocab_size, num_intents=num_intents,
+            num_entities=num_entities, word_emb_dim=word_emb_dim,
+            lstm_units=lstm_units, dropout=dropout)
+        self.estimator = TPUEstimator(
+            self.module, loss=_intent_entity_loss(num_intents, num_entities),
+            optimizer=optimizer)
+
+    @staticmethod
+    def pack_labels(intents: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        return np.concatenate([np.asarray(intents).reshape(-1, 1),
+                               np.asarray(slots)], axis=1).astype(np.int32)
+
+    def fit(self, x, intents, slots, batch_size: int = 32, epochs: int = 1,
+            **kw):
+        y = self.pack_labels(intents, slots)
+        return self.estimator.fit({"x": x, "y": y}, epochs=epochs,
+                                  batch_size=batch_size, **kw)
+
+    def predict(self, x, batch_size: int = 32
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        packed = np.asarray(self.estimator.predict(x,
+                                                   batch_size=batch_size))
+        intent = np.argmax(packed[:, 0, :self.num_intents], axis=-1)
+        slots = np.argmax(packed[:, 1:, :self.num_entities], axis=-1)
+        return intent, slots
+
+    def save_model(self, path: str):
+        return self.estimator.save(path)
+
+    def load_model(self, path: str):
+        self.estimator.load(path)
+        return self

@@ -232,23 +232,190 @@ def bench_ncf(smoke: bool) -> dict:
             "batch": batch, "streamed": True}
 
 
+def bench_fraud_mlp(smoke: bool) -> dict:
+    """BASELINE config #3: NNEstimator fraud-detection MLP (reference runs a
+    Keras-style MLP over NNEstimator/NNFrames on a Spark cluster; here the
+    NNFrames path feeds the jitted engine). Tabular binary classification on
+    synthetic card-fraud-shaped data (29 features, heavy class imbalance)."""
+    import jax
+    import pandas as pd
+    from analytics_zoo_tpu.pipeline.nnframes import NNEstimator
+
+    n_features = 29
+    batch = 1024 if smoke else 16384
+    n = batch * 4
+    epochs = 1 if smoke else 3
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, n_features).astype(np.float32)
+    y = (rng.rand(n) < 0.02).astype(np.float32)   # ~2% fraud
+    df = pd.DataFrame({"features": list(x), "label": y})
+
+    import flax.linen as nn
+
+    class FraudMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for width in (256, 128, 64):
+                x = nn.relu(nn.Dense(width)(x))
+            return nn.sigmoid(nn.Dense(1)(x))[..., 0]
+
+    if smoke:
+        est = (NNEstimator(FraudMLP(), "binary_crossentropy")
+               .setBatchSize(batch).setMaxEpoch(epochs))
+        t0 = time.perf_counter()
+        est.fit(df)
+        dt = time.perf_counter() - t0
+        samples = n * epochs
+    else:
+        # exclude one-time jit compile: time a 1-epoch and an (1+epochs)-
+        # epoch fit and take the difference (both pay the same compile)
+        t0 = time.perf_counter()
+        (NNEstimator(FraudMLP(), "binary_crossentropy")
+         .setBatchSize(batch).setMaxEpoch(1).fit(df))
+        dt1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        (NNEstimator(FraudMLP(), "binary_crossentropy")
+         .setBatchSize(batch).setMaxEpoch(1 + epochs).fit(df))
+        dt = max(time.perf_counter() - t0 - dt1, 1e-6)
+        samples = n * epochs
+    per_chip = samples / dt / max(jax.device_count(), 1)
+    # no published reference number; estimate: this 4-layer MLP on one A100
+    # sustains ~8M samples/s (batch-bound) -> scaled constant like NCF's
+    base = 8_000_000.0
+    return {"metric": "nnestimator_fraud_mlp_throughput_per_chip",
+            "value": round(per_chip, 1), "unit": "samples/sec/chip",
+            "vs_baseline": round(per_chip / base, 3),
+            "batch": batch, "epochs": epochs, "streamed": True}
+
+
+def bench_autots_trials(smoke: bool) -> dict:
+    """BASELINE config #4: Zouwu AutoTS hyperparameter trials. The reference
+    farms LSTM/TCN trials to Ray workers; here trials run chip-pinned through
+    TPUSearchEngine. Metric: completed trials/hour (per chip)."""
+    import pandas as pd
+    from analytics_zoo_tpu.zouwu.autots.forecast import AutoTSTrainer
+    from analytics_zoo_tpu.zouwu.config.recipe import LSTMGridRandomRecipe
+
+    n_points = 400 if smoke else 2000
+    ts = pd.date_range("2024-01-01", periods=n_points, freq="h")
+    rng = np.random.RandomState(0)
+    value = (np.sin(np.arange(n_points) / 24 * 2 * np.pi) +
+             0.1 * rng.randn(n_points)).astype(np.float32)
+    df = pd.DataFrame({"datetime": ts, "value": value})
+
+    n_trials = 1 if smoke else 2
+    recipe = LSTMGridRandomRecipe(num_rand_samples=n_trials,
+                                  epochs=1 if smoke else 5)
+    trainer = AutoTSTrainer(dt_col="datetime", target_col="value", horizon=1)
+    t0 = time.perf_counter()
+    pipeline = trainer.fit(df, validation_df=None, recipe=recipe)
+    dt = time.perf_counter() - t0
+    assert pipeline is not None
+    # trial count mirrors TPUSearchEngine.compile: grid axes × num_samples
+    from analytics_zoo_tpu.automl import hp as hp_dsl
+    trials_done = (len(hp_dsl.grid_configs(recipe.search_space([]))) *
+                   recipe.num_samples)
+    per_hour = trials_done / dt * 3600.0
+    # reference point: the AutoTS use-case notebook budgets ~30 LSTM trials
+    # per hour per worker on Xeon (no published number; estimate)
+    base = 30.0
+    return {"metric": "autots_lstm_trials_per_hour",
+            "value": round(per_hour, 1), "unit": "trials/hour/chip",
+            "vs_baseline": round(per_hour / base, 3),
+            "trials": trials_done, "series_len": n_points}
+
+
+def bench_serving_od(smoke: bool) -> dict:
+    """BASELINE config #5: Cluster-Serving object detection. Tiny-SSD served
+    through the batching engine + in-memory broker (transport excluded so the
+    number is the serving engine + model, matching how the reference reads
+    Flink numRecordsOutPerSecond). Reports throughput + latency percentiles
+    from the engine Timer."""
+    import jax
+    from analytics_zoo_tpu.models.image.objectdetection import ObjectDetector
+    from analytics_zoo_tpu.serving import (ClusterServing, InMemoryBroker,
+                                           InputQueue, OutputQueue)
+
+    size = 64 if smoke else 128
+    n_req = 64 if smoke else 512
+    det = ObjectDetector(class_names=("a", "b", "c"), image_size=size,
+                         model_type="ssd_tiny", max_gt=4)
+    det.compile()
+    model = det.as_inference_model(max_detections=20)
+
+    broker = InMemoryBroker()
+    serving = ClusterServing(model, queue=broker, batch_size=16,
+                             batch_timeout_ms=5).start()
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(n_req, size, size, 3).astype(np.float32)
+    try:
+        iq = InputQueue(queue=broker)
+        oq = OutputQueue(queue=broker)
+        # warmup: two full batches so the steady-state bucket (batch 16)
+        # compiles before measurement
+        for i in range(32):
+            iq.enqueue(f"warm-{i}", t=imgs[i % n_req])
+        oq.dequeue([f"warm-{i}" for i in range(32)], timeout_s=300)
+
+        t0 = time.perf_counter()
+        uris = []
+        for i in range(n_req):
+            uris.append(iq.enqueue(f"r-{i}", t=imgs[i]))
+        results = oq.dequeue(uris, timeout_s=300)
+        dt = time.perf_counter() - t0
+        assert len(results) == n_req
+        bad = [u for u, v in results.items()
+               if np.asarray(v).shape != (20, 6)]
+        assert not bad, (f"{len(bad)} serving results are error payloads "
+                         f"(first: {bad[0]})")
+        stages = serving.metrics()["stages"]
+        infer = stages.get("inference", {})
+        per_sec = n_req / dt
+        return {"metric": "cluster_serving_od_throughput",
+                "value": round(per_sec, 1), "unit": "records/sec/chip",
+                # reference publishes no absolute number (BASELINE.md:16);
+                # scale target: saturate one chip. Report vs 200 rec/s
+                # (20-box tiny-SSD on CPU serving estimate).
+                "vs_baseline": round(per_sec / 200.0, 3),
+                "image_size": size, "requests": n_req,
+                "inference_ms_mean": round(infer.get("mean_ms", 0.0), 2),
+                "inference_ms_p99": round(infer.get("p99_ms", 0.0), 2)}
+    finally:
+        serving.stop()
+
+
 def main():
     from analytics_zoo_tpu import init_orca_context
     init_orca_context("local")
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+    only = os.environ.get("BENCH_ONLY", "").split(",") if \
+        os.environ.get("BENCH_ONLY") else None
 
-    resnet_res = bench_resnet50(smoke)
-    ncf_res = bench_ncf(smoke)
+    benches = {"resnet50": bench_resnet50, "ncf": bench_ncf,
+               "fraud_mlp": bench_fraud_mlp, "autots": bench_autots_trials,
+               "serving_od": bench_serving_od}
+    detail = {"smoke": smoke}
+    for name, fn in benches.items():
+        if only and name not in only:
+            continue
+        try:
+            detail[name] = fn(smoke)
+        except Exception as e:  # one failed workload must not hide the rest
+            detail[name] = {"error": f"{type(e).__name__}: {e}"}
 
-    detail = {"resnet50": resnet_res, "ncf": ncf_res, "smoke": smoke}
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_DETAIL.json"), "w") as f:
         json.dump(detail, f, indent=2)
 
-    out = dict(resnet_res)
+    resnet_res = detail.get("resnet50", {})
+    out = dict(resnet_res) if "error" not in resnet_res else {}
     out.pop("step_flops", None)
-    out["ncf_samples_per_sec_per_chip"] = ncf_res["value"]
-    out["ncf_vs_baseline"] = ncf_res["vs_baseline"]
+    for name, key in (("ncf", "ncf"), ("fraud_mlp", "fraud_mlp"),
+                      ("autots", "autots"), ("serving_od", "serving_od")):
+        r = detail.get(name, {})
+        if r and "error" not in r:
+            out[f"{key}_value"] = r["value"]
+            out[f"{key}_vs_baseline"] = r["vs_baseline"]
     print(json.dumps(out))
 
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Smoke-run every example (the reference drives its notebook apps the same
+# way: apps/run-app-tests*.sh). CPU-friendly: forces the 8-device virtual
+# mesh so no TPU is required.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+for script in \
+    examples/orca/learn/ncf_movielens.py \
+    examples/orca/learn/resnet50_imagenet.py \
+    examples/nnframes/fraud_detection_mlp.py \
+    examples/zouwu/autots_forecast.py \
+    examples/serving/object_detection_serving.py; do
+  echo "=== $script --smoke"
+  python "$script" --smoke
+done
+echo "all example smoke tests passed"

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""AutoTS time-series forecasting — BASELINE workload #4.
+
+The reference's zouwu AutoTS flow (pyzoo/zoo/zouwu/autots/forecast.py):
+AutoTSTrainer.fit runs hyperparameter trials (Ray there, chip-pinned
+thread pool here) and returns a TSPipeline for inference/incremental fit.
+
+Usage:
+    python examples/zouwu/autots_forecast.py --smoke
+    python examples/zouwu/autots_forecast.py --csv my_series.csv \
+        --dt-col timestamp --target-col value
+"""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+
+def synthetic_series(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    t = pd.date_range("2024-01-01", periods=n, freq="h")
+    daily = np.sin(np.arange(n) / 24 * 2 * np.pi)
+    weekly = 0.5 * np.sin(np.arange(n) / (24 * 7) * 2 * np.pi)
+    noise = 0.1 * rng.randn(n)
+    return pd.DataFrame({"datetime": t,
+                         "value": (daily + weekly + noise).astype(
+                             np.float32)})
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--csv", default=None)
+    p.add_argument("--dt-col", default="datetime")
+    p.add_argument("--target-col", default="value")
+    p.add_argument("--horizon", type=int, default=1)
+    p.add_argument("--trials", type=int, default=4)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.zouwu.autots.forecast import AutoTSTrainer
+    from analytics_zoo_tpu.zouwu.config.recipe import (LSTMGridRandomRecipe,
+                                                       SmokeRecipe)
+
+    init_orca_context("local")
+    try:
+        df = pd.read_csv(args.csv) if args.csv else synthetic_series(
+            400 if args.smoke else 2000)
+        if args.csv:
+            df[args.dt_col] = pd.to_datetime(df[args.dt_col])
+        split = int(len(df) * 0.9)
+        train_df, val_df = df.iloc[:split], df.iloc[split:]
+
+        trainer = AutoTSTrainer(dt_col=args.dt_col,
+                                target_col=args.target_col,
+                                horizon=args.horizon)
+        recipe = (SmokeRecipe() if args.smoke else
+                  LSTMGridRandomRecipe(num_rand_samples=args.trials))
+        pipeline = trainer.fit(train_df, validation_df=val_df, recipe=recipe)
+
+        pred = pipeline.predict(val_df)
+        print(f"best config: { {k: v for k, v in pipeline.config.items()} }")
+        print(f"forecast shape: {np.asarray(pred).shape}")
+
+        ev = pipeline.evaluate(val_df, metrics=["mse", "smape"])
+        print("holdout:", {k: round(float(v), 5) for k, v in ev.items()})
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -122,12 +122,14 @@ def test_training_operator_hooks(orca_context):
     assert np.isfinite(stats[0]["train_loss"])
 
 
-def test_unsupported_torch_module_raises(orca_context):
+def test_custom_forward_now_converts_via_fx(orca_context):
+    """Round 1 rejected custom forward(); the fx tracer now converts it
+    (full coverage in tests/test_fx_bridge.py). Genuinely unconvertible ops
+    must still raise with guidance."""
     torch = pytest.importorskip("torch")
     import torch.nn as tnn
-    from analytics_zoo_tpu.orca.learn.pytorch import Estimator
     from analytics_zoo_tpu.orca.learn.pytorch.torch_bridge import (
-        TorchConversionError)
+        TorchConversionError, build_flax_from_torch)
 
     class Custom(tnn.Module):
         def __init__(self):
@@ -137,9 +139,15 @@ def test_unsupported_torch_module_raises(orca_context):
         def forward(self, x):
             return self.l(x) * 2
 
+    module, loader = build_flax_from_torch(Custom())
+    assert module is not None
+
+    class Unconvertible(tnn.Module):
+        def forward(self, x):
+            return torch.fft.fft(x).real
+
     with pytest.raises(TorchConversionError):
-        Estimator.from_torch(model_creator=lambda cfg: Custom(),
-                             loss_creator=lambda cfg: tnn.MSELoss())
+        build_flax_from_torch(Unconvertible())
 
 
 # ---------------- tf2/keras path --------------------------------------------

@@ -1,0 +1,279 @@
+"""torch.fx-traced conversion: custom forward() graphs -> flax.
+
+Every test builds a torch module with non-Sequential control flow (residual
+adds, concats, reshapes), converts it, imports the torch weights, and
+compares outputs numerically against torch eval-mode inference.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn              # noqa: E402
+import torch.nn.functional as F     # noqa: E402
+
+import jax                          # noqa: E402
+
+from analytics_zoo_tpu.orca.learn.pytorch.torch_bridge import (  # noqa: E402
+    TorchConversionError, build_flax_from_torch)
+
+
+def _convert_and_compare(module, x_np, rtol=1e-4, atol=1e-5):
+    module.eval()
+    with torch.no_grad():
+        expected = module(torch.from_numpy(x_np)).numpy()
+    flax_mod, loader = build_flax_from_torch(module)
+    variables = flax_mod.init(jax.random.PRNGKey(0), x_np)
+    variables = loader(variables)
+    got = np.asarray(flax_mod.apply(variables, x_np))
+    np.testing.assert_allclose(got, expected, rtol=rtol, atol=atol)
+    return flax_mod, variables
+
+
+class BasicBlock(tnn.Module):
+    """torchvision-style residual block (custom forward with identity add)."""
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        out += identity
+        return F.relu(out)
+
+
+class TinyResNet(tnn.Module):
+    """The torchvision ResNet skeleton at toy size: stem conv + maxpool,
+    residual stages, global pool, flatten, fc — all custom forward."""
+
+    def __init__(self, num_classes=7):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 8, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(8)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        self.layer1 = tnn.Sequential(BasicBlock(8, 8), BasicBlock(8, 8))
+        self.layer2 = tnn.Sequential(BasicBlock(8, 16, 2),
+                                     BasicBlock(16, 16))
+        self.avgpool = tnn.AdaptiveAvgPool2d((1, 1))
+        self.fc = tnn.Linear(16, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.avgpool(x)
+        x = torch.flatten(x, 1)
+        return self.fc(x)
+
+
+def test_resnet_style_custom_forward():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 32, 32).astype(np.float32)
+    _convert_and_compare(TinyResNet(), x, rtol=1e-3, atol=1e-4)
+
+
+def test_custom_mlp_with_residual_and_concat():
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = tnn.Linear(10, 16)
+            self.fc2 = tnn.Linear(16, 16)
+            self.head = tnn.Linear(32, 3)
+
+        def forward(self, x):
+            h = F.gelu(self.fc1(x))
+            h = h + torch.tanh(self.fc2(h))       # residual
+            h = torch.cat([h, h.relu()], dim=1)   # concat + tensor method
+            return F.log_softmax(self.head(h), dim=-1)
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(4, 10).astype(np.float32)
+    _convert_and_compare(Net(), x)
+
+
+def test_view_size_and_permute():
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = tnn.Linear(12, 6)
+
+        def forward(self, x):
+            b = x.size(0)
+            h = x.permute(0, 2, 1).contiguous()
+            h = h.view(b, -1)
+            return self.fc(h)
+
+    rng = np.random.RandomState(2)
+    x = rng.rand(3, 4, 3).astype(np.float32)
+    _convert_and_compare(Net(), x)
+
+
+def test_grouped_conv_supported_via_fx():
+    """The Sequential path rejects grouped convs; the fx path handles them
+    with feature_group_count."""
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(8, 8, 3, padding=1, groups=4)
+
+        def forward(self, x):
+            return F.relu(self.conv(x))
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 8, 8, 8).astype(np.float32)
+    _convert_and_compare(Net(), x)
+
+
+def test_unsupported_op_names_the_node():
+    class Net(tnn.Module):
+        def forward(self, x):
+            return torch.fft.fft(x).real
+
+    with pytest.raises(TorchConversionError) as ei:
+        build_flax_from_torch(Net())
+    assert "fft" in str(ei.value) or "trace" in str(ei.value)
+
+
+def test_keras_functional_branching_graph(orca_context):
+    """Functional keras model with a branch + Add + Concatenate converts
+    through the DAG path and matches tf inference numerically."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.orca.learn.tf2.keras_bridge import (
+        build_flax_from_keras)
+
+    inp = tf.keras.Input(shape=(8,))
+    a = tf.keras.layers.Dense(16, activation="relu", name="a")(inp)
+    b = tf.keras.layers.Dense(16, activation="tanh", name="b")(inp)
+    added = tf.keras.layers.Add(name="merge_add")([a, b])
+    cat = tf.keras.layers.Concatenate(name="merge_cat")([added, a])
+    out = tf.keras.layers.Dense(3, name="head")(cat)
+    model = tf.keras.Model(inp, out)
+
+    rng = np.random.RandomState(5)
+    x = rng.rand(4, 8).astype(np.float32)
+    expected = model(x).numpy()
+
+    flax_mod, loader = build_flax_from_keras(model)
+    variables = loader(flax_mod.init(jax.random.PRNGKey(0), x))
+    got = np.asarray(flax_mod.apply(variables, x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_multi_input_graph(orca_context):
+    """Two-input functional model (wide & deep shape) through the DAG."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.orca.learn.tf2.keras_bridge import (
+        build_flax_from_keras)
+
+    wide = tf.keras.Input(shape=(4,), name="wide")
+    deep = tf.keras.Input(shape=(6,), name="deep")
+    d = tf.keras.layers.Dense(8, activation="relu")(deep)
+    merged = tf.keras.layers.Concatenate()([wide, d])
+    out = tf.keras.layers.Dense(2)(merged)
+    model = tf.keras.Model([wide, deep], out)
+
+    rng = np.random.RandomState(6)
+    xw = rng.rand(3, 4).astype(np.float32)
+    xd = rng.rand(3, 6).astype(np.float32)
+    expected = model([xw, xd]).numpy()
+
+    flax_mod, loader = build_flax_from_keras(model)
+    variables = loader(flax_mod.init(jax.random.PRNGKey(0), xw, xd))
+    got = np.asarray(flax_mod.apply(variables, xw, xd))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_shared_layer_rejected(orca_context):
+    """A layer called at two graph sites (shared weights) must raise at
+    build time, not silently mis-wire."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.orca.learn.tf2.keras_bridge import (
+        KerasConversionError, build_flax_from_keras)
+
+    inp = tf.keras.Input(shape=(4,))
+    shared = tf.keras.layers.Dense(4, name="shared")
+    a = shared(inp)
+    b = shared(a)
+    model = tf.keras.Model(inp, tf.keras.layers.Add()([a, b]))
+    with pytest.raises(KerasConversionError) as ei:
+        build_flax_from_keras(model)
+    assert "shared" in str(ei.value)
+
+
+def test_fx_rejects_silently_divergent_configs():
+    """ceil_mode pooling / non-zeros conv padding change semantics the jax
+    lowering doesn't reproduce — must raise, not silently diverge."""
+    from analytics_zoo_tpu.orca.learn.pytorch.fx_bridge import (
+        build_flax_from_torch_fx)
+
+    class CeilPool(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.pool = tnn.MaxPool2d(3, 2, ceil_mode=True)
+
+        def forward(self, x):
+            return self.pool(x) + 0
+
+    with pytest.raises(TorchConversionError) as ei:
+        build_flax_from_torch_fx(CeilPool())
+    assert "ceil_mode" in str(ei.value)
+
+    class ReflectConv(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(2, 2, 3, padding=1,
+                                   padding_mode="reflect")
+
+        def forward(self, x):
+            return self.conv(x) + 0
+
+    with pytest.raises(TorchConversionError) as ei:
+        build_flax_from_torch_fx(ReflectConv())
+    assert "padding_mode" in str(ei.value)
+
+
+def test_converted_model_trains_in_estimator(orca_context):
+    """The fx-converted module must plug into the unified engine and train
+    (grads flow through the interpreted graph)."""
+    from analytics_zoo_tpu.orca.learn.pytorch import Estimator
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = tnn.Linear(8, 16)
+            self.fc2 = tnn.Linear(16, 16)
+            self.head = tnn.Linear(16, 2)
+
+        def forward(self, x):
+            h = F.relu(self.fc1(x))
+            h = h + F.relu(self.fc2(h))
+            return self.head(h)
+
+    rng = np.random.RandomState(4)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 2, 64).astype(np.int64)
+
+    def model_creator(config):
+        return Net()
+
+    def optimizer_creator(model, config):
+        return torch.optim.Adam(model.parameters(), lr=1e-2)
+
+    est = Estimator.from_torch(model_creator=model_creator,
+                               optimizer_creator=optimizer_creator,
+                               loss_creator=lambda c: tnn.CrossEntropyLoss())
+    stats = est.fit({"x": x, "y": y}, epochs=2, batch_size=32)
+    assert np.isfinite(stats[-1]["train_loss"])
