@@ -1,0 +1,3 @@
+from .feature_set import DiskFeatureSet, FeatureSet
+
+__all__ = ["FeatureSet", "DiskFeatureSet"]

@@ -1,0 +1,234 @@
+"""FeatureSet cache tiers — bigger-than-RAM training epochs.
+
+The reference's FeatureSet hierarchy (zoo/.../feature/FeatureSet.scala:
+556-647) offers DRAM and DISK_n tiers: DISK keeps the dataset on local disk
+and pulls a sliding window of partitions per epoch so datasets larger than
+cluster RAM still train. TPU-native equivalent:
+
+* ``FeatureSet.from_arrays(..., tier="dram")`` — thin wrapper over the
+  in-memory BatchIterator path (host RAM model).
+* ``FeatureSet.from_arrays(..., tier="disk")`` / ``from_xshards`` /
+  ``from_tfrecords`` — columns are spooled to npy shards under a cache dir
+  once, then every epoch streams batches out of memory-mapped shards with
+  block shuffling (shard order + within-shard permutation — random-enough
+  without random disk IO, the same trade the reference's DiskFeatureSet
+  makes with its numSlice windows). Feeds the same InfeedPump/Batch
+  contract the estimators consume, so ``fit(featureset, ...)`` works
+  unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..orca.data.shard import HostXShards
+
+
+def _as_tuple(v) -> Tuple:
+    if v is None:
+        return ()
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,)
+
+
+class DiskFeatureSet:
+    """Disk-backed column store; duck-types the BatchIterator contract
+    (``epoch()``/``steps_per_epoch``/``_host_batches``) that
+    ``TPUEstimator.fit`` and the bench consume."""
+
+    def __init__(self, cache_dir: str, mesh, batch_size: int,
+                 seed: int = 0, _owns_dir: bool = False):
+        import jax
+
+        self.cache_dir = cache_dir
+        self.mesh = mesh
+        self.seed = seed
+        self._owns_dir = _owns_dir
+        meta = np.load(os.path.join(cache_dir, "meta.npy"),
+                       allow_pickle=True).item()
+        self.n: int = meta["n"]
+        self.n_x: int = meta["n_x"]
+        self.n_y: int = meta["n_y"]
+        self.shard_rows: List[int] = meta["shard_rows"]
+
+        nproc = jax.process_count()
+        self.local_bs = max(batch_size // max(nproc, 1), 1)
+        data_axis = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        local_div = max(data_axis // max(nproc, 1), 1)
+        if self.local_bs % local_div:
+            self.local_bs = math.ceil(self.local_bs / local_div) * local_div
+        self.global_bs = self.local_bs * max(nproc, 1)
+        # tail rows that don't fill a whole global batch are dropped (jit
+        # steps are fixed-shape; a padded tail batch belongs to the DRAM
+        # BatchIterator path, which masks via weights)
+        self.steps_per_epoch = self.n // self.global_bs
+        if self.steps_per_epoch == 0:
+            raise ValueError(f"{self.n} rows < local batch {self.local_bs}")
+        self._epoch_idx = 0
+
+    # --- construction -------------------------------------------------------
+    @staticmethod
+    def write(data: Dict[str, Any], cache_dir: str,
+              shard_size: int = 65536) -> str:
+        """Spool {'x': arr|tuple, 'y': arr|tuple} into npy column shards."""
+        os.makedirs(cache_dir, exist_ok=True)
+        xs = _as_tuple(data.get("x"))
+        ys = _as_tuple(data.get("y"))
+        n = len(xs[0])
+        shard_rows = []
+        for s, start in enumerate(range(0, n, shard_size)):
+            end = min(start + shard_size, n)
+            for i, a in enumerate(xs):
+                np.save(os.path.join(cache_dir, f"shard-{s:05d}-x{i}.npy"),
+                        np.asarray(a[start:end]))
+            for i, a in enumerate(ys):
+                np.save(os.path.join(cache_dir, f"shard-{s:05d}-y{i}.npy"),
+                        np.asarray(a[start:end]))
+            shard_rows.append(end - start)
+        np.save(os.path.join(cache_dir, "meta.npy"),
+                {"n": n, "n_x": len(xs), "n_y": len(ys),
+                 "shard_rows": shard_rows})
+        return cache_dir
+
+    # --- iteration ----------------------------------------------------------
+    def _mmap(self, s: int, kind: str, i: int) -> np.ndarray:
+        return np.load(os.path.join(self.cache_dir,
+                                    f"shard-{s:05d}-{kind}{i}.npy"),
+                       mmap_mode="r")
+
+    def _host_batches(self, shuffle: bool) -> Iterator:
+        import jax
+        from ..orca.learn.utils import Batch
+
+        rng = np.random.RandomState(self.seed + self._epoch_idx)
+        self._epoch_idx += 1
+        shard_order = np.arange(len(self.shard_rows))
+        if shuffle:
+            rng.shuffle(shard_order)
+
+        pid = jax.process_index()
+        nproc = max(jax.process_count(), 1)
+        w = np.ones(self.local_bs, np.float32)
+        # carry buffers span shard boundaries so batches are exact-size
+        carry_x: List[List[np.ndarray]] = [[] for _ in range(self.n_x)]
+        carry_y: List[List[np.ndarray]] = [[] for _ in range(self.n_y)]
+        carried = 0
+        emitted = 0
+
+        def drain():
+            nonlocal carried, emitted
+            while carried >= self.local_bs and emitted < self.steps_per_epoch:
+                xs, ys = [], []
+                for i in range(self.n_x):
+                    cat = np.concatenate(carry_x[i]) if len(carry_x[i]) > 1 \
+                        else carry_x[i][0]
+                    xs.append(cat[:self.local_bs])
+                    carry_x[i] = [cat[self.local_bs:]]
+                for i in range(self.n_y):
+                    cat = np.concatenate(carry_y[i]) if len(carry_y[i]) > 1 \
+                        else carry_y[i][0]
+                    ys.append(cat[:self.local_bs])
+                    carry_y[i] = [cat[self.local_bs:]]
+                carried -= self.local_bs
+                emitted += 1
+                yield Batch(x=tuple(xs), y=tuple(ys) or None, w=w)
+
+        # stripe over the GLOBAL row index space so every process gets the
+        # same row count (+-1) regardless of per-shard row counts — unequal
+        # stripes would make processes emit different batch counts and
+        # deadlock the collective in a multihost step
+        global_offset = 0
+        for s in shard_order:
+            rows = self.shard_rows[s]
+            start = (pid - global_offset) % nproc
+            local = np.arange(start, rows, nproc)
+            global_offset += rows
+            if shuffle:
+                rng.shuffle(local)
+            for i in range(self.n_x):
+                carry_x[i].append(np.asarray(self._mmap(s, "x", i)[local]))
+            for i in range(self.n_y):
+                carry_y[i].append(np.asarray(self._mmap(s, "y", i)[local]))
+            carried += len(local)
+            yield from drain()
+        self._last_emitted = emitted
+
+    def _put_batch(self, b):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..orca.learn.utils import Batch
+
+        def put(a):
+            sh = NamedSharding(
+                self.mesh, P(*((("dp", "fsdp"),) + (None,) * (a.ndim - 1))))
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, a)
+            return jax.device_put(a, sh)
+
+        return Batch(x=tuple(put(a) for a in b.x),
+                     y=tuple(put(a) for a in b.y) if b.y else None,
+                     w=put(b.w))
+
+    def epoch(self, shuffle: bool = True, prefetch: bool = True):
+        if not prefetch:
+            for b in self._host_batches(shuffle):
+                yield self._put_batch(b)
+            return
+        from ..native.infeed import InfeedPump
+        yield from InfeedPump(lambda: self._host_batches(shuffle),
+                              device_put=self._put_batch, depth=2)
+
+    def cleanup(self):
+        if self._owns_dir:
+            shutil.rmtree(self.cache_dir, ignore_errors=True)
+
+
+class FeatureSet:
+    """Tier selector mirroring the reference's FeatureSet.rdd(memoryType=...)
+    entry points (FeatureSet.scala:556: DRAM / PMEM / DISK_n)."""
+
+    @staticmethod
+    def from_arrays(data: Dict[str, Any], tier: str = "dram",
+                    mesh=None, batch_size: int = 32,
+                    cache_dir: Optional[str] = None,
+                    shard_size: int = 65536, seed: int = 0):
+        tier = tier.lower()
+        if tier == "dram":
+            from ..orca.learn import utils as learn_utils
+            if mesh is None:
+                from ..common.context import get_context
+                mesh = get_context().mesh
+            return learn_utils.data_to_iterator(data, batch_size, mesh,
+                                                shuffle=True, seed=seed)
+        if tier.startswith("disk"):
+            if mesh is None:
+                from ..common.context import get_context
+                mesh = get_context().mesh
+            owns = cache_dir is None
+            cache_dir = cache_dir or tempfile.mkdtemp(prefix="zoo_diskfs_")
+            DiskFeatureSet.write(data, cache_dir, shard_size=shard_size)
+            return DiskFeatureSet(cache_dir, mesh, batch_size, seed=seed,
+                                  _owns_dir=owns)
+        raise ValueError(f"unknown tier {tier!r} (dram | disk); the "
+                         "reference's PMEM tier has no TPU-host analogue — "
+                         "use disk")
+
+    @staticmethod
+    def from_xshards(shards: HostXShards, tier: str = "disk", **kw):
+        from ..orca.learn.utils import concat_shards
+        return FeatureSet.from_arrays(concat_shards(shards), tier=tier, **kw)
+
+    @staticmethod
+    def from_tfrecords(paths, feature_cols=None, label_cols=None,
+                       tier: str = "disk", **kw):
+        from ..orca.data.tfrecord import read_tfrecords_as_xshards
+        shards = read_tfrecords_as_xshards(paths, feature_cols=feature_cols,
+                                           label_cols=label_cols)
+        return FeatureSet.from_xshards(shards, tier=tier, **kw)

@@ -1,0 +1,3 @@
+from .caffe_loader import (CaffeLoader, load_caffe_weights, parse_caffemodel)
+
+__all__ = ["CaffeLoader", "parse_caffemodel", "load_caffe_weights"]

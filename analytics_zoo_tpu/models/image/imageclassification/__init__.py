@@ -1,0 +1,6 @@
+from .classifier import (IMAGENET_TOP_CONFIGS, ImageClassifier,
+                         LabelOutput)
+from .inception import InceptionV1
+
+__all__ = ["ImageClassifier", "InceptionV1", "LabelOutput",
+           "IMAGENET_TOP_CONFIGS"]

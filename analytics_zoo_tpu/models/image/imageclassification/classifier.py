@@ -1,0 +1,155 @@
+"""ImageClassifier — the reference's image-classification model family
+(pyzoo/zoo/models/image/imageclassification/image_classifier.py:
+ImageClassifier.load_model(model_path) + predict_image_set + LabelOutput,
+with a published config family "<model>-<dataset>-<version>").
+
+TPU-native: the config family maps names to flax modules (inception-v1,
+resnet-18/34/50/101/152), training runs on the unified engine through the
+ZooModel surface, prediction fuses preprocessing + forward + (optional)
+softmax into one XLA program per batch bucket, and Caffe-era published
+weights import through models.caffe.CaffeLoader.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ...common.zoo_model import ZooModel
+from .inception import InceptionV1
+
+
+def _resnet_factory(depth):
+    def make(num_classes, **kw):
+        from ..resnet import resnet
+        return resnet(depth=depth, num_classes=num_classes, **kw)
+    return make
+
+
+# the reference's model-definition family ("imageclassification" configs)
+IMAGENET_TOP_CONFIGS: Dict[str, Callable] = {
+    "inception-v1": lambda num_classes, **kw: InceptionV1(
+        num_classes=num_classes, **kw),
+    "resnet-18": _resnet_factory(18),
+    "resnet-34": _resnet_factory(34),
+    "resnet-50": _resnet_factory(50),
+    "resnet-101": _resnet_factory(101),
+    "resnet-152": _resnet_factory(152),
+}
+
+
+class LabelOutput:
+    """Turn class probabilities into (label, confidence) pairs (reference
+    LabelOutput transform over label_map)."""
+
+    def __init__(self, label_map: Optional[Dict[int, str]] = None,
+                 top_k: int = 5):
+        self.label_map = label_map or {}
+        self.top_k = top_k
+
+    def __call__(self, probs: np.ndarray):
+        probs = np.asarray(probs)
+        idx = np.argsort(-probs, axis=-1)[..., :self.top_k]
+        conf = np.take_along_axis(probs, idx, axis=-1)
+        labels = np.vectorize(
+            lambda i: self.label_map.get(int(i), str(int(i))))(idx)
+        return [list(zip(labels[i], conf[i].tolist()))
+                for i in range(len(probs))]
+
+
+class ImageClassifier(ZooModel):
+    """Config-family image classifier (reference image_classifier.py)."""
+
+    def __init__(self, model_name: str = "inception-v1",
+                 num_classes: int = 1000,
+                 label_map: Optional[Dict[int, str]] = None, **net_kwargs):
+        if model_name not in IMAGENET_TOP_CONFIGS:
+            raise ValueError(
+                f"unknown model config {model_name!r}; known: "
+                f"{sorted(IMAGENET_TOP_CONFIGS)}")
+        self.model_name = model_name
+        self.num_classes = num_classes
+        self.label_map = label_map or {}
+        self._net_kwargs = dict(net_kwargs)
+        super().__init__(IMAGENET_TOP_CONFIGS[model_name](num_classes,
+                                                          **net_kwargs))
+
+    def compile(self, loss="sparse_categorical_crossentropy_from_logits",
+                optimizer="adam", metrics=("sparse_categorical_accuracy",),
+                **kwargs):
+        if loss == "sparse_categorical_crossentropy_from_logits":
+            from functools import partial
+
+            from ....orca.learn.losses import (
+                sparse_categorical_crossentropy)
+            loss = partial(sparse_categorical_crossentropy, from_logits=True)
+        return super().compile(loss=loss, optimizer=optimizer,
+                               metrics=list(metrics or []), **kwargs)
+
+    # --- inference surface --------------------------------------------------
+    def predict_image_set(self, images, top_k: Optional[int] = None,
+                          batch_size: int = 256):
+        """images: (n, h, w, 3) array or ImageSet; returns probabilities, or
+        top-k (label, confidence) lists when top_k is given (reference
+        predict_image_set + LabelOutput pipeline)."""
+        arr = images.to_array() if hasattr(images, "to_array") else \
+            np.asarray(images)
+        logits = np.asarray(self.predict(arr, batch_size=batch_size))
+        probs = _softmax_np(logits)
+        if top_k:
+            return LabelOutput(self.label_map, top_k)(probs)
+        return probs
+
+    def load_caffe_weights(self, caffemodel_path: str,
+                           name_map: Optional[Dict[str, str]] = None):
+        """Import published Caffe weights (reference loads its zoo downloads
+        the same way; models/caffe/caffe_loader.py does the wire parsing)."""
+        import jax
+
+        from ...caffe import load_caffe_weights
+        eng = self.estimator.engine
+        if eng.params is None:
+            raise RuntimeError("call fit/build first (params uninitialized)")
+        variables = {"params": jax.device_get(eng.params),
+                     **jax.device_get(eng.extra_vars)}
+        loaded = load_caffe_weights(variables, caffemodel_path,
+                                    name_map=name_map)
+        state = eng.get_state()
+        state["params"] = loaded["params"]
+        state["extra_vars"] = {k: v for k, v in loaded.items()
+                               if k != "params"}
+        eng.set_state(state)
+        return self
+
+    # --- persistence --------------------------------------------------------
+    def save_model(self, path: str, over_write: bool = False):
+        import os
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(path)
+        blob = {"model_name": self.model_name,
+                "num_classes": self.num_classes,
+                "label_map": self.label_map,
+                "net_kwargs": self._net_kwargs,
+                "state": self.estimator.engine.get_state()}
+        with open(path, "wb") as f:
+            pickle.dump(blob, f)
+        return path
+
+    @classmethod
+    def load_model(cls, path: str):
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        obj = cls(model_name=blob["model_name"],
+                  num_classes=blob["num_classes"],
+                  label_map=blob["label_map"], **blob["net_kwargs"])
+        obj.compile()
+        obj.estimator.engine.set_state(blob["state"])
+        return obj
+
+
+def _softmax_np(logits: np.ndarray) -> np.ndarray:
+    z = logits - logits.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)

@@ -1,0 +1,109 @@
+"""Inception v1 (GoogLeNet) in flax — the reference's headline ImageNet
+training workload (Scala twin: zoo/.../examples/inception/Train.scala +
+Inception model in the BigDL zoo; BASELINE.md row 1 is its 256-node scaling
+claim).
+
+TPU-first: NHWC, bf16 compute / f32 params, every branch of an inception
+block is 1x1/3x3/5x5 convs that tile the MXU; branches concatenate on the
+channel axis so XLA fuses the block into a handful of convolutions. The
+auxiliary classifier heads of the paper exist for vanishing-gradient-era
+optimization and are omitted (BatchNorm makes them unnecessary); BN follows
+each conv (the "inception-v1 with BN" variant the reference trains).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (1x1, (3x3 reduce, 3x3), (5x5 reduce, 5x5), pool proj) per block
+V1_BLOCKS: Sequence[Tuple] = (
+    ("3a", 64, (96, 128), (16, 32), 32),
+    ("3b", 128, (128, 192), (32, 96), 64),
+    ("pool",),
+    ("4a", 192, (96, 208), (16, 48), 64),
+    ("4b", 160, (112, 224), (24, 64), 64),
+    ("4c", 128, (128, 256), (24, 64), 64),
+    ("4d", 112, (144, 288), (32, 64), 64),
+    ("4e", 256, (160, 320), (32, 128), 128),
+    ("pool",),
+    ("5a", 256, (160, 320), (32, 128), 128),
+    ("5b", 384, (192, 384), (48, 128), 128),
+)
+
+
+class InceptionBlock(nn.Module):
+    one: int
+    three: Tuple[int, int]
+    five: Tuple[int, int]
+    pool_proj: int
+    conv: type = nn.Conv
+    norm: type = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        def cbr(t, features, kernel, name):
+            t = self.conv(features, kernel, use_bias=False, padding="SAME",
+                          name=f"{name}_conv")(t)
+            t = self.norm(name=f"{name}_bn")(t)
+            return nn.relu(t)
+
+        b1 = cbr(x, self.one, (1, 1), "b1")
+        b2 = cbr(cbr(x, self.three[0], (1, 1), "b2_reduce"),
+                 self.three[1], (3, 3), "b2")
+        b3 = cbr(cbr(x, self.five[0], (1, 1), "b3_reduce"),
+                 self.five[1], (5, 5), "b3")
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbr(b4, self.pool_proj, (1, 1), "b4_proj")
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV1(nn.Module):
+    num_classes: int = 1000
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    dropout: float = 0.4
+    return_logits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, dtype=self.compute_dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=self.compute_dtype,
+                       param_dtype=jnp.float32)
+        if x.dtype == jnp.uint8:
+            from ....orca.data.image.imagenet import (IMAGENET_MEAN,
+                                                      IMAGENET_STD)
+            import numpy as np
+            mean = jnp.asarray(IMAGENET_MEAN, self.compute_dtype)
+            inv = jnp.asarray(1.0 / np.asarray(IMAGENET_STD),
+                              self.compute_dtype)
+            x = (x.astype(self.compute_dtype) - mean) * inv
+        x = x.astype(self.compute_dtype)
+
+        x = conv(64, (7, 7), (2, 2), padding="SAME", use_bias=False,
+                 name="stem_conv")(x)
+        x = nn.relu(norm(name="stem_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = conv(64, (1, 1), use_bias=False, name="reduce_conv")(x)
+        x = nn.relu(norm(name="reduce_bn")(x))
+        x = conv(192, (3, 3), padding="SAME", use_bias=False,
+                 name="stem2_conv")(x)
+        x = nn.relu(norm(name="stem2_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for cfg in V1_BLOCKS:
+            if cfg[0] == "pool":
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+                continue
+            name, one, three, five, proj = cfg
+            x = InceptionBlock(one, three, five, proj, conv=conv, norm=norm,
+                               name=f"inception_{name}")(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x if self.return_logits else nn.softmax(x)

@@ -27,11 +27,7 @@ from typing import Any, Callable, Dict, List, Tuple
 
 import numpy as np
 
-from .torch_bridge import TorchConversionError
-
-
-def _pair(v):
-    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+from .torch_bridge import TorchConversionError, _pair
 
 
 def _sanitize(target: str) -> str:
@@ -241,16 +237,22 @@ def build_flax_from_torch_fx(module):
 
     nodes = list(gm.graph.nodes)
     submodules = dict(gm.named_modules())
-    # constants reachable via get_attr (buffers, captured tensors)
+    # get_attr targets: buffers/captured tensors become frozen constants,
+    # but nn.Parameters accessed directly in forward() must stay TRAINABLE —
+    # they become flax params initialized from the torch value
     consts: Dict[str, np.ndarray] = {}
+    param_attrs: Dict[str, np.ndarray] = {}
     for node in nodes:
         if node.op == "get_attr":
             obj = gm
             for part in str(node.target).split("."):
                 obj = getattr(obj, part)
-            consts[str(node.target)] = (
-                obj.detach().cpu().numpy() if hasattr(obj, "detach")
-                else np.asarray(obj))
+            arr = (obj.detach().cpu().numpy() if hasattr(obj, "detach")
+                   else np.asarray(obj))
+            if isinstance(obj, torch.nn.Parameter):
+                param_attrs[str(node.target)] = arr
+            else:
+                consts[str(node.target)] = arr
 
     # pre-validate module nodes so conversion errors fire at build time
     _MOD_KINDS = (tnn.Linear, tnn.Conv2d, tnn.BatchNorm1d, tnn.BatchNorm2d,
@@ -259,8 +261,17 @@ def build_flax_from_torch_fx(module):
                   tnn.Identity, tnn.ReLU, tnn.ReLU6, tnn.GELU, tnn.SiLU,
                   tnn.ELU, tnn.Sigmoid, tnn.Tanh, tnn.Softmax,
                   tnn.LogSoftmax, tnn.LeakyReLU, tnn.Hardtanh)
+    seen_targets = set()
     for node in nodes:
         if node.op == "call_module":
+            if str(node.target) in seen_targets and \
+                    submodules[str(node.target)].state_dict():
+                # flax compact naming can't express torch weight sharing
+                raise TorchConversionError(
+                    f"module '{node.target}' is called more than once "
+                    "(weight sharing); duplicate the layer or port the "
+                    "model to flax with explicit param reuse")
+            seen_targets.add(str(node.target))
             sub = submodules[str(node.target)]
             if not isinstance(sub, _MOD_KINDS):
                 raise TorchConversionError(
@@ -281,6 +292,18 @@ def build_flax_from_torch_fx(module):
                 raise TorchConversionError(
                     f"pool with ceil_mode=True at '{node.target}' is not "
                     "supported (output shape would silently differ)")
+            if isinstance(sub, tnn.MaxPool2d) and \
+                    _pair(sub.dilation) != (1, 1):
+                raise TorchConversionError(
+                    f"MaxPool2d with dilation at '{node.target}' is not "
+                    "supported")
+            if isinstance(sub, tnn.AvgPool2d) and (
+                    not sub.count_include_pad
+                    or sub.divisor_override is not None):
+                raise TorchConversionError(
+                    f"AvgPool2d with count_include_pad=False or "
+                    f"divisor_override at '{node.target}' is not supported "
+                    "(values would silently differ)")
 
     import flax.linen as fnn
     import jax.numpy as jnp
@@ -318,7 +341,14 @@ def build_flax_from_torch_fx(module):
                         # placeholder with default (e.g. train flag)
                         env[node.name] = node.args[0] if node.args else None
                 elif node.op == "get_attr":
-                    env[node.name] = jnp.asarray(consts[str(node.target)])
+                    target = str(node.target)
+                    if target in param_attrs:
+                        init_val = param_attrs[target]
+                        env[node.name] = self.param(
+                            _sanitize(target),
+                            lambda rng, v=init_val: jnp.asarray(v))
+                    else:
+                        env[node.name] = jnp.asarray(consts[target])
                 elif node.op == "call_module":
                     sub = submodules[str(node.target)]
                     x = lookup(node.args)[0]
@@ -366,16 +396,24 @@ def build_flax_from_torch_fx(module):
                 return y
             if isinstance(sub, (tnn.BatchNorm1d, tnn.BatchNorm2d)):
                 axis = 1 if x.ndim > 2 else -1
+                # torch momentum=None means cumulative averaging (no flax
+                # analogue; use the 0.1 default); momentum=0.0 means frozen
+                # stats, which maps to flax momentum=1.0 — `or 0.1` would
+                # silently turn frozen BN into updating BN
+                t_mom = 0.1 if sub.momentum is None else sub.momentum
                 return fnn.BatchNorm(
                     use_running_average=not train,
-                    momentum=1.0 - (sub.momentum or 0.1), epsilon=sub.eps,
+                    momentum=1.0 - t_mom, epsilon=sub.eps,
                     axis=axis, use_bias=sub.affine, use_scale=sub.affine,
                     name=nm)(x)
             if isinstance(sub, tnn.LayerNorm):
                 if len(sub.normalized_shape) != 1:
                     raise TorchConversionError(
                         f"LayerNorm over multiple dims at '{target}'")
-                return fnn.LayerNorm(epsilon=sub.eps, name=nm)(x)
+                affine = sub.elementwise_affine
+                return fnn.LayerNorm(epsilon=sub.eps, use_scale=affine,
+                                     use_bias=affine and sub.bias is not None,
+                                     name=nm)(x)
             if isinstance(sub, tnn.Embedding):
                 return fnn.Embed(sub.num_embeddings, sub.embedding_dim,
                                  name=nm)(x.astype(jnp.int32))
@@ -431,6 +469,8 @@ def build_flax_from_torch_fx(module):
         variables = jax.tree.map(np.asarray, jax.device_get(variables))
         params = dict(variables.get("params", {}))
         batch_stats = dict(variables.get("batch_stats", {}))
+        for target in param_attrs:      # directly-accessed nn.Parameters
+            params[_sanitize(target)] = state[target]
         for node in nodes:
             if node.op != "call_module":
                 continue
@@ -453,8 +493,10 @@ def build_flax_from_torch_fx(module):
                     "mean": state[f"{target}.running_mean"],
                     "var": state[f"{target}.running_var"]}
             elif isinstance(sub, tnn.LayerNorm):
-                params[nm] = {"scale": state[f"{target}.weight"],
-                              "bias": state[f"{target}.bias"]}
+                if sub.elementwise_affine:
+                    params[nm] = {"scale": state[f"{target}.weight"]}
+                    if sub.bias is not None:
+                        params[nm]["bias"] = state[f"{target}.bias"]
             elif isinstance(sub, tnn.Embedding):
                 params[nm] = {"embedding": state[f"{target}.weight"]}
         out = {"params": params}

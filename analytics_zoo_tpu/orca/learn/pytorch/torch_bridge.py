@@ -103,23 +103,16 @@ def _op_specs_from_torch(module) -> List[Dict[str, Any]]:
     import torch.nn as tnn2
     if isinstance(module, tnn2.Sequential):
         emit(module, "")
+    elif type(module).forward is tnn2.Module.forward:
+        emit(module, "")
     else:
-        # module whose forward is effectively sequential over children and
-        # has no custom logic: only safe if forward is not overridden
-        if type(module).forward is not tnn2.Sequential.forward and \
-                type(module).forward is not tnn2.Module.forward:
-            # check for the common pattern: a single Sequential child
-            children = dict(module.named_children())
-            if len(children) == 1 and isinstance(
-                    next(iter(children.values())), tnn2.Sequential):
-                emit(next(iter(children.values())),
-                     next(iter(children.keys())))
-            else:
-                raise TorchConversionError(
-                    f"cannot convert {type(module).__name__}: custom forward()"
-                    " requires manual porting to flax/jax")
-        else:
-            emit(module, "")
+        # any overridden forward() — even one that only calls a child
+        # Sequential — may add logic a layer walk can't see (e.g.
+        # `return self.seq(x) + 1`); route to the fx graph tracer, which
+        # converts the actual data flow
+        raise TorchConversionError(
+            f"{type(module).__name__} has a custom forward(); tracing "
+            "required")
     return specs
 
 

@@ -147,6 +147,66 @@ def test_unsupported_op_names_the_node():
     assert "fft" in str(ei.value) or "trace" in str(ei.value)
 
 
+def test_sequential_child_with_extra_logic_uses_fx():
+    """A module wrapping a Sequential but adding logic in forward() must
+    convert through fx, not silently drop the extra op (round-2 review)."""
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.seq = tnn.Sequential(tnn.Linear(4, 4))
+
+        def forward(self, x):
+            return self.seq(x) + 1.0
+
+    rng = np.random.RandomState(7)
+    x = rng.rand(3, 4).astype(np.float32)
+    _convert_and_compare(Net(), x)   # would differ by 1.0 if seq-only
+
+
+def test_direct_parameter_is_trainable():
+    """nn.Parameter accessed straight in forward() (get_attr node) must
+    become a flax param — frozen-constant conversion trains silently
+    wrong."""
+    import jax.numpy as jnp
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.scale = tnn.Parameter(torch.full((4,), 2.0))
+            self.fc = tnn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x) * self.scale
+
+    net = Net()
+    rng = np.random.RandomState(8)
+    x = rng.rand(3, 4).astype(np.float32)
+    flax_mod, variables = _convert_and_compare(net, x)
+    assert "scale" in variables["params"], list(variables["params"])
+    np.testing.assert_allclose(np.asarray(variables["params"]["scale"]),
+                               np.full(4, 2.0))
+    # gradient actually flows into it
+    def loss(p):
+        return jnp.sum(flax_mod.apply({**variables, "params": p}, x) ** 2)
+    g = jax.grad(loss)(variables["params"])
+    assert float(np.abs(np.asarray(g["scale"])).sum()) > 0
+
+
+def test_layernorm_without_affine():
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln = tnn.LayerNorm(6, elementwise_affine=False)
+            self.fc = tnn.Linear(6, 2)
+
+        def forward(self, x):
+            return self.fc(self.ln(x))
+
+    rng = np.random.RandomState(9)
+    x = rng.rand(5, 6).astype(np.float32)
+    _convert_and_compare(Net(), x)
+
+
 def test_keras_functional_branching_graph(orca_context):
     """Functional keras model with a branch + Add + Concatenate converts
     through the DAG path and matches tf inference numerically."""

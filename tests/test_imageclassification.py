@@ -1,0 +1,77 @@
+"""ImageClassifier config family + Inception v1."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier, InceptionV1, LabelOutput)
+
+
+def _toy_images(n=16, size=32, classes=3, seed=0):
+    """Images whose mean brightness encodes the class — learnable fast."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = rng.rand(n, size, size, 3).astype(np.float32) * 0.2
+    x += y[:, None, None, None] / classes
+    return x, y.astype(np.int32)
+
+
+def test_inception_v1_forward_shape(orca_context):
+    import jax
+
+    m = InceptionV1(num_classes=10)
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(v, x)
+    assert np.asarray(out).shape == (2, 10)
+    # 9 inception blocks present
+    blocks = [k for k in v["params"] if k.startswith("inception_")]
+    assert len(blocks) == 9
+
+
+def test_classifier_trains_and_predicts(orca_context):
+    x, y = _toy_images(n=32, classes=3)
+    clf = ImageClassifier("inception-v1", num_classes=3)
+    clf.compile(optimizer="adam")
+    s1 = clf.fit({"x": x, "y": y}, epochs=1, batch_size=16, verbose=False)
+    s2 = clf.fit({"x": x, "y": y}, epochs=4, batch_size=16, verbose=False)
+    assert s2[-1]["train_loss"] < s1[-1]["train_loss"]
+
+    probs = clf.predict_image_set(x[:4])
+    assert probs.shape == (4, 3)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+
+    top = clf.predict_image_set(x[:4], top_k=2)
+    assert len(top) == 4 and len(top[0]) == 2
+
+
+def test_classifier_config_family(orca_context):
+    clf = ImageClassifier("resnet-18", num_classes=4)
+    x, y = _toy_images(n=16, classes=4)
+    clf.compile()
+    clf.fit({"x": x, "y": y}, epochs=1, batch_size=16, verbose=False)
+    assert clf.predict_image_set(x[:2]).shape == (2, 4)
+    with pytest.raises(ValueError):
+        ImageClassifier("vgg-19")
+
+
+def test_classifier_save_load_roundtrip(orca_context, tmp_path):
+    x, y = _toy_images(n=16, classes=3)
+    clf = ImageClassifier("inception-v1", num_classes=3,
+                          label_map={0: "cat", 1: "dog", 2: "bird"})
+    clf.compile()
+    clf.fit({"x": x, "y": y}, epochs=1, batch_size=16, verbose=False)
+    p1 = clf.predict_image_set(x[:4])
+    path = str(tmp_path / "clf.pkl")
+    clf.save_model(path)
+    clf2 = ImageClassifier.load_model(path)
+    np.testing.assert_allclose(clf2.predict_image_set(x[:4]), p1, rtol=1e-5)
+    top = clf2.predict_image_set(x[:1], top_k=1)
+    assert top[0][0][0] in ("cat", "dog", "bird")
+
+
+def test_label_output():
+    probs = np.asarray([[0.1, 0.7, 0.2]])
+    out = LabelOutput({0: "a", 1: "b", 2: "c"}, top_k=2)(probs)
+    assert out[0][0] == ("b", pytest.approx(0.7))
+    assert out[0][1] == ("c", pytest.approx(0.2))
