@@ -113,6 +113,16 @@ def init_orca_context(cluster_mode: str = "local",
                            "context (call stop_orca_context first to rebuild)")
             return _current
 
+        if cluster_mode in ("tpu", "multihost"):
+            # launch-script contract (scripts/launch_multihost.sh): topology
+            # arrives via env when not passed explicitly
+            coordinator_address = coordinator_address or os.environ.get(
+                "ZOO_COORDINATOR")
+            if num_processes is None and os.environ.get("ZOO_NUM_PROCS"):
+                num_processes = int(os.environ["ZOO_NUM_PROCS"])
+            if process_id is None and os.environ.get("ZOO_PROC_ID"):
+                process_id = int(os.environ["ZOO_PROC_ID"])
+
         cfg = config or OrcaConfig()
         cfg = cfg.replace(cluster_mode=cluster_mode,
                           coordinator_address=coordinator_address,

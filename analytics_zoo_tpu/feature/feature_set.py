@@ -115,7 +115,7 @@ class DiskFeatureSet:
 
         pid = jax.process_index()
         nproc = max(jax.process_count(), 1)
-        w = np.ones(self.local_bs, np.float32)
+        w = None  # full batches only; jit synthesizes the unit weights
         # carry buffers span shard boundaries so batches are exact-size
         carry_x: List[List[np.ndarray]] = [[] for _ in range(self.n_x)]
         carry_y: List[List[np.ndarray]] = [[] for _ in range(self.n_y)]
@@ -174,7 +174,7 @@ class DiskFeatureSet:
 
         return Batch(x=tuple(put(a) for a in b.x),
                      y=tuple(put(a) for a in b.y) if b.y else None,
-                     w=put(b.w))
+                     w=put(b.w) if b.w is not None else None)
 
     def epoch(self, shuffle: bool = True, prefetch: bool = True):
         if not prefetch:

@@ -84,7 +84,9 @@ class ImageClassifier(ZooModel):
 
             from ....orca.learn.losses import (
                 sparse_categorical_crossentropy)
-            loss = partial(sparse_categorical_crossentropy, from_logits=True)
+            loss = partial(
+                sparse_categorical_crossentropy,
+                from_logits=self._net_kwargs.get("return_logits", True))
         return super().compile(loss=loss, optimizer=optimizer,
                                metrics=list(metrics or []), **kwargs)
 
@@ -96,8 +98,11 @@ class ImageClassifier(ZooModel):
         predict_image_set + LabelOutput pipeline)."""
         arr = images.to_array() if hasattr(images, "to_array") else \
             np.asarray(images)
-        logits = np.asarray(self.predict(arr, batch_size=batch_size))
-        probs = _softmax_np(logits)
+        out = np.asarray(self.predict(arr, batch_size=batch_size))
+        # nets built with return_logits=False already emit probabilities;
+        # re-softmaxing would flatten confidences toward uniform
+        probs = (out if self._net_kwargs.get("return_logits") is False
+                 else _softmax_np(out))
         if top_k:
             return LabelOutput(self.label_map, top_k)(probs)
         return probs

@@ -152,13 +152,14 @@ class ImageNetPipeline:
         # each process reads its own stripe of the global order
         pid = jax.process_index()
         order = order[pid::max(jax.process_count(), 1)]
-        w = np.ones(self.local_bs, np.float32)
         for s in range(self.steps_per_epoch):
             idx = order[s * self.local_bs:(s + 1) * self.local_bs]
             if len(idx) < self.local_bs:
                 break
             imgs = self._assemble(mmaps, table[idx], rng)
-            yield Batch(x=(imgs,), y=(labels[idx],), w=w)
+            # w=None: full batches, weights synthesized inside the jit —
+            # one less per-step host->device transfer
+            yield Batch(x=(imgs,), y=(labels[idx],), w=None)
 
     # --- device side ---------------------------------------------------------
     def _put_batch(self, b):
@@ -171,7 +172,8 @@ class ImageNetPipeline:
                 return jax.make_array_from_process_local_data(sh, a)
             return jax.device_put(a, sh)
         return Batch(x=tuple(put(a) for a in b.x),
-                     y=tuple(put(a) for a in b.y), w=put(b.w))
+                     y=tuple(put(a) for a in b.y),
+                     w=put(b.w) if b.w is not None else None)
 
     def epoch(self, shuffle: Optional[bool] = None, prefetch: bool = True):
         shuffle = self.train if shuffle is None else shuffle

@@ -23,8 +23,9 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
-from ...utils.protostream import decode_fields, read_varint, varint
-from ...utils.tensorboard import _masked_crc, _pb_bytes, _tag
+from ...utils.protostream import (decode_fields, pb_packed_floats,
+                                  pb_packed_int64s, read_varint, varint)
+from ...utils.tensorboard import _masked_crc, _pb_bytes
 
 
 # --------------------------------------------------------------------------
@@ -72,16 +73,6 @@ def write_records(path: str, payloads: Iterator[bytes]) -> int:
 # tf.train.Example encode / decode
 # --------------------------------------------------------------------------
 
-def _pb_packed_floats(field: int, vals) -> bytes:
-    body = struct.pack(f"<{len(vals)}f", *[float(v) for v in vals])
-    return _tag(field, 2) + varint(len(body)) + body
-
-
-def _pb_packed_int64s(field: int, vals) -> bytes:
-    body = b"".join(varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in vals)
-    return _tag(field, 2) + varint(len(body)) + body
-
-
 def encode_example(features: Dict[str, Any]) -> bytes:
     """dict -> serialized tf.train.Example. Values: bytes/str -> bytes_list,
     float arrays -> float_list, int arrays -> int64_list."""
@@ -99,10 +90,10 @@ def encode_example(features: Dict[str, Any]) -> bytes:
             arr = np.asarray(val)
             if arr.dtype.kind in "iub":
                 feature = _pb_bytes(
-                    3, _pb_packed_int64s(1, arr.ravel().tolist()))
+                    3, pb_packed_int64s(1, arr.ravel().tolist()))
             else:
                 feature = _pb_bytes(
-                    2, _pb_packed_floats(1, arr.ravel().tolist()))
+                    2, pb_packed_floats(1, arr.ravel().tolist()))
         entry = _pb_bytes(1, key.encode()) + _pb_bytes(2, feature)
         entries.append(_pb_bytes(1, entry))
     return _pb_bytes(1, b"".join(entries))

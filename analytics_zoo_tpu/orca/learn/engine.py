@@ -275,6 +275,8 @@ class TrainEngine:
             y0 = y[0] if (isinstance(y, tuple) and len(y) == 1) else y
             per_ex = self.loss_fn(y0, preds)
         per_ex = per_ex.reshape(per_ex.shape[0], -1).mean(-1)
+        if w is None:       # full batch, weights synthesized (all ones)
+            return jnp.mean(per_ex)
         return jnp.sum(per_ex * w) / jnp.maximum(jnp.sum(w), 1e-8)
 
     # --- steps --------------------------------------------------------------
@@ -300,6 +302,8 @@ class TrainEngine:
         y0 = None
         if y is not None:
             y0 = y[0] if (isinstance(y, tuple) and len(y) == 1) else y
+        if w is None:
+            w = jnp.ones(x[0].shape[0], jnp.float32)
         new_states = {}
         for name, m in self.metrics.items():
             new_states[name] = m.update(metric_states[name], y0, preds, w)

@@ -322,8 +322,13 @@ class TPUEstimator:
         outs = []
         for batch in it.epoch(shuffle=False):
             preds = self.engine.predict_batch(batch.x)
-            mask = np.asarray(jax.device_get(batch.w)) > 0
             pred_np = jax.device_get(preds)
+            if batch.w is None:                 # full batch, no padding
+                outs.append(tuple(np.asarray(p) for p in pred_np)
+                            if isinstance(pred_np, (list, tuple))
+                            else np.asarray(pred_np))
+                continue
+            mask = np.asarray(jax.device_get(batch.w)) > 0
             if isinstance(pred_np, (list, tuple)):
                 outs.append(tuple(np.asarray(p)[mask] for p in pred_np))
             else:

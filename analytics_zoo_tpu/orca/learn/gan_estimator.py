@@ -100,6 +100,11 @@ class GANEstimator:
             self.d_loss_fn).parameters) >= 3
 
         def d_step(g_params, d_params, d_opt, real, w, rng):
+            if w is None and takes_weights:
+                # full batches ship w=None; user 3-arg loss fns were written
+                # against the "(batch,) of ones" contract — synthesize it
+                # in-jit (free, XLA folds it)
+                w = jnp.ones(real.shape[0], jnp.float32)
             noise = jax.random.normal(rng, (real.shape[0], self.noise_dim))
             fake = self.generator.apply({"params": g_params}, noise)
 

@@ -41,8 +41,10 @@ class TrainingOperator:
         """(reference: training_operator.py:220)"""
         import jax
         loss = self._engine.train_batch(batch)
+        n = (len(batch.x[0]) if batch.w is None     # None == unpadded batch
+             else int(batch.w.sum()))
         return {"train_loss": float(jax.device_get(loss)),
-                "num_samples": int(batch.w.sum())}
+                "num_samples": n}
 
     def validate(self, val_iterator: Iterator, info: Dict, metrics
                  ) -> Dict[str, float]:

@@ -32,7 +32,9 @@ class Batch:
     """One global batch: tuples of feature/label arrays plus a mask weight."""
     x: Tuple[np.ndarray, ...]
     y: Optional[Tuple[np.ndarray, ...]]
-    w: np.ndarray  # (batch,) 1.0 for real rows, 0.0 for padding
+    # (batch,) 1.0 for real rows, 0.0 for padding; None == all ones (the
+    # jitted step synthesizes them on device — no transfer for full batches)
+    w: Optional[np.ndarray]
 
 
 def _as_tuple(v) -> Tuple:
@@ -215,8 +217,13 @@ class BatchIterator:
             if real < self.local_bs:
                 idx = np.concatenate(
                     [idx, np.zeros(self.local_bs - real, dtype=idx.dtype)])
-            w = np.zeros(self.local_bs, dtype=np.float32)
-            w[:real] = 1.0
+                w = np.zeros(self.local_bs, dtype=np.float32)
+                w[:real] = 1.0
+            else:
+                # full batch: weights are all ones — send None and let the
+                # jitted step synthesize them, saving a per-step
+                # host->device transfer (the infeed is the scarce resource)
+                w = None
             xs = tuple(gather_rows(a, idx) for a in xs_src)
             ys = (tuple(gather_rows(a, idx) for a in ys_src)
                   if ys_src is not None else None)
@@ -227,7 +234,7 @@ class BatchIterator:
             x=tuple(self._device_put(a) for a in b.x),
             y=(tuple(self._device_put(a) for a in b.y)
                if b.y is not None else None),
-            w=self._device_put(b.w))
+            w=self._device_put(b.w) if b.w is not None else None)
 
     def epoch(self, shuffle: Optional[bool] = None,
               prefetch: bool = True) -> Iterator[Batch]:

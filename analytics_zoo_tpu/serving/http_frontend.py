@@ -19,7 +19,11 @@ from .codecs import decode_payload, encode_payload
 from .queue_api import Broker, make_broker
 
 
-def create_app(queue="memory://serving_stream", timeout_s: float = 30.0):
+def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
+               serving=None):
+    """``serving``: optional ClusterServing engine to expose under
+    GET /metrics (the reference surfaces Flink numRecordsOutPerSecond +
+    stage timers the same way, ClusterServingGuide:525)."""
     from aiohttp import web
 
     broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
@@ -27,6 +31,16 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0):
     async def index(request):
         return web.Response(text="welcome to analytics zoo tpu serving "
                                  "frontend")
+
+    async def metrics(request):
+        # pending() can block (Redis XLEN round-trip, spool-dir listing) —
+        # keep it off the event loop like the predict handler's fetches
+        loop = asyncio.get_running_loop()
+        pending = await loop.run_in_executor(None, broker.pending)
+        body = {"pending": pending}
+        if serving is not None:
+            body.update(serving.metrics())
+        return web.json_response(body)
 
     async def predict(request):
         body = await request.json()
@@ -64,6 +78,7 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0):
 
     app = web.Application()
     app.router.add_get("/", index)
+    app.router.add_get("/metrics", metrics)
     app.router.add_post("/predict", predict)
     app.router.add_put("/predict", predict)
     return app

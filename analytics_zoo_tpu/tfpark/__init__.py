@@ -49,6 +49,24 @@ class TFDataset:
             "use orca.data XShards in place of FeatureSet on TPU")
 
     @classmethod
+    def from_tfrecord_file(cls, paths, feature_cols, label_cols=None,
+                           batch_size: int = -1, **kwargs):
+        """TFRecord corpus -> dataset (reference tf_dataset.py:480
+        TFRecordDataset form) via the dependency-free reader in
+        orca.data.tfrecord."""
+        from ..orca.data.tfrecord import read_tfrecords_as_xshards
+        from ..orca.learn.utils import concat_shards
+        shards = read_tfrecords_as_xshards(paths, feature_cols=feature_cols,
+                                           label_cols=label_cols)
+        merged = concat_shards(shards)
+        x = merged["x"]
+        x = x[0] if len(x) == 1 else x
+        y = merged.get("y")
+        if y is not None:
+            y = y[0] if len(y) == 1 else y
+        return cls(x, y, batch_size)
+
+    @classmethod
     def from_dataframe(cls, df, feature_cols, labels_cols=None, **kwargs):
         x = np.stack([np.asarray(v) for v in
                       df[feature_cols].to_numpy()]).astype(np.float32)

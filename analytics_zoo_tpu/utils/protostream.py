@@ -20,6 +20,22 @@ def varint(n: int) -> bytes:
             return bytes(out)
 
 
+def pb_tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def pb_packed_floats(field: int, vals) -> bytes:
+    """Length-delimited packed float32 list (FloatList.value and friends)."""
+    body = struct.pack(f"<{len(vals)}f", *[float(v) for v in vals])
+    return pb_tag(field, 2) + varint(len(body)) + body
+
+
+def pb_packed_int64s(field: int, vals) -> bytes:
+    """Length-delimited packed varint list (Int64List.value, BlobShape.dim)."""
+    body = b"".join(varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in vals)
+    return pb_tag(field, 2) + varint(len(body)) + body
+
+
 def read_varint(data: bytes, i: int) -> Tuple[int, int]:
     v = 0
     shift = 0

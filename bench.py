@@ -394,7 +394,18 @@ def main():
     benches = {"resnet50": bench_resnet50, "ncf": bench_ncf,
                "fraud_mlp": bench_fraud_mlp, "autots": bench_autots_trials,
                "serving_od": bench_serving_od}
-    detail = {"smoke": smoke}
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    # merge into the existing record: a BENCH_ONLY partial run must not
+    # clobber the other workloads' stored results
+    detail = {}
+    if os.path.exists(detail_path):
+        try:
+            with open(detail_path) as f:
+                detail = json.load(f)
+        except Exception:
+            detail = {}
+    detail["smoke"] = smoke
     for name, fn in benches.items():
         if only and name not in only:
             continue
@@ -403,8 +414,7 @@ def main():
         except Exception as e:  # one failed workload must not hide the rest
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
 
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_DETAIL.json"), "w") as f:
+    with open(detail_path, "w") as f:
         json.dump(detail, f, indent=2)
 
     resnet_res = detail.get("resnet50", {})

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Launch the same training program on every host of a TPU pod slice.
+#
+# The reference ships spark-submit / ray-start launch scripts (scripts/,
+# pyzoo/zoo/scripts); the TPU-native equivalent is much smaller because the
+# runtime is single-controller-per-host SPMD: every host runs the SAME
+# python program, and jax.distributed.initialize (called by
+# init_orca_context(cluster_mode="multihost", ...)) wires them up.
+#
+# On Cloud TPU VMs the canonical form is:
+#
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#     --command="$(bash scripts/launch_multihost.sh --emit \
+#                  python train.py --epochs 10)"
+#
+# On bare clusters, run this script once per host with HOSTS set, or use
+# the --emit form with your own parallel-ssh tooling.
+#
+# Environment contract consumed by init_orca_context:
+#   ZOO_COORDINATOR  host:port of process 0 (default: first host :8476)
+#   ZOO_NUM_PROCS    number of hosts
+#   ZOO_PROC_ID      this host's rank
+set -euo pipefail
+
+if [[ "${1:-}" == "--emit" ]]; then
+    shift
+    # print the per-worker command for gcloud --worker=all style launchers;
+    # TPU_WORKER_ID is provided by the TPU VM environment
+    echo "ZOO_COORDINATOR=\${ZOO_COORDINATOR:?set to host0:8476}" \
+         "ZOO_NUM_PROCS=\${TPU_WORKER_COUNT:-4}" \
+         "ZOO_PROC_ID=\${TPU_WORKER_ID}" "$@"
+    exit 0
+fi
+
+: "${HOSTS:?space-separated host list, e.g. HOSTS='tpu-0 tpu-1 tpu-2 tpu-3'}"
+PROGRAM=("$@")
+read -ra HOST_ARR <<<"$HOSTS"
+NUM=${#HOST_ARR[@]}
+COORD="${HOST_ARR[0]}:${ZOO_COORDINATOR_PORT:-8476}"
+
+pids=()
+for i in "${!HOST_ARR[@]}"; do
+    ssh "${HOST_ARR[$i]}" \
+        "ZOO_COORDINATOR=$COORD ZOO_NUM_PROCS=$NUM ZOO_PROC_ID=$i \
+         ${PROGRAM[*]}" &
+    pids+=($!)
+done
+rc=0
+for p in "${pids[@]}"; do wait "$p" || rc=$?; done
+exit $rc

@@ -12,24 +12,15 @@ import pytest
 
 from analytics_zoo_tpu.models.caffe import (CaffeLoader, load_caffe_weights,
                                             parse_caffemodel)
-from analytics_zoo_tpu.utils.protostream import varint
-from analytics_zoo_tpu.utils.tensorboard import _pb_bytes, _pb_string, _tag
-
-
-def _pb_packed_floats(field, vals):
-    body = struct.pack(f"<{len(vals)}f", *[float(v) for v in vals])
-    return _tag(field, 2) + varint(len(body)) + body
-
-
-def _pb_packed_int64(field, vals):
-    body = b"".join(varint(int(v)) for v in vals)
-    return _tag(field, 2) + varint(len(body)) + body
+from analytics_zoo_tpu.utils.protostream import (pb_packed_floats,
+                                                 pb_packed_int64s)
+from analytics_zoo_tpu.utils.tensorboard import _pb_bytes, _pb_string
 
 
 def _blob(arr):
     arr = np.asarray(arr, np.float32)
-    shape = _pb_bytes(7, _pb_packed_int64(1, arr.shape))
-    return shape + _pb_packed_floats(5, arr.ravel().tolist())
+    shape = _pb_bytes(7, pb_packed_int64s(1, arr.shape))
+    return shape + pb_packed_floats(5, arr.ravel().tolist())
 
 
 def _layer(name, ltype, blobs):

@@ -157,6 +157,50 @@ def test_make_broker_redis_uri(mini_redis):
     b.close()
 
 
+def test_http_metrics_endpoint(orca_context):
+    """GET /metrics surfaces broker backlog + engine stage timers (the
+    reference reads Flink numRecordsOutPerSecond the same way)."""
+    import asyncio
+
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import InMemoryBroker
+    from analytics_zoo_tpu.serving.http_frontend import create_app
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 3), np.float32))
+    model = InferenceModel().load_jax(module, variables)
+    broker = InMemoryBroker()
+    serving = ClusterServing(model, queue=broker, batch_size=4,
+                             batch_timeout_ms=10).start()
+    try:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def run():
+            app = create_app(queue=broker, serving=serving)
+            async with TestClient(TestServer(app)) as client:
+                resp = await client.post(
+                    "/predict", json={"instances": [{"t": [1.0, 2.0, 3.0]}]})
+                assert resp.status == 200
+                m = await (await client.get("/metrics")).json()
+                return m
+
+        m = asyncio.new_event_loop().run_until_complete(run())
+        assert m["records_out"] >= 1
+        assert "inference" in m["stages"]
+        assert "pending" in m
+    finally:
+        serving.stop()
+
+
 def test_cluster_serving_over_redis(mini_redis, orca_context):
     """Full serving e2e across the wire: client enqueues over RESP, engine
     claims over RESP, result comes back through the hash store."""

@@ -124,6 +124,22 @@ def test_tfrecords_to_xshards(tmp_path):
     assert parts[0]["y"][0].shape == (8,)
 
 
+def test_tfpark_tfdataset_from_tfrecord(tmp_path, orca_context):
+    """tfpark.TFDataset.from_tfrecord_file (reference tf_dataset.py:480
+    TFRecordDataset form) over the dependency-free reader."""
+    from analytics_zoo_tpu.tfpark import TFDataset
+
+    path = str(tmp_path / "tp.tfrecord")
+    rng = np.random.RandomState(2)
+    write_tfrecords(path, iter([{"f": rng.rand(5).astype(np.float32),
+                                 "l": np.asarray([i % 2], np.int64)}
+                                for i in range(40)]))
+    ds = TFDataset.from_tfrecord_file(path, feature_cols=["f"],
+                                      label_cols=["l"], batch_size=16)
+    assert ds.x.shape == (40, 5)
+    assert ds.y.shape == (40,)
+
+
 def test_disk_featureset_streams_epochs(tmp_path, orca_context):
     """disk tier: batches stream from npy shards (block-shuffled), cover the
     dataset exactly, and feed fit() unchanged."""
