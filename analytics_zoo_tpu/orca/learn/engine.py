@@ -332,7 +332,9 @@ class TrainEngine:
 
     def eval_batch(self, metric_states, batch: Batch):
         if self._jit_eval is None:
-            self._jit_eval = jax.jit(self._eval_step)
+            # metric states are consumed and replaced every batch — donate
+            # them so XLA updates in place instead of reallocating
+            self._jit_eval = jax.jit(self._eval_step, donate_argnums=(2,))
         return self._jit_eval(self.params, self.extra_vars, metric_states,
                               batch.x, batch.y, batch.w)
 

@@ -84,7 +84,11 @@ class InferenceModel:
         import jax.numpy as jnp
 
         if self._variables is None:
-            raise RuntimeError("load a model before quantize()")
+            raise RuntimeError(
+                "no variables to quantize. load_jax/load/load_tf initialize "
+                "them eagerly; load_torch defers init until the first "
+                "predict() (input shape unknown) — run one predict, then "
+                "quantize()")
         variables = jax.device_get(self._variables)
 
         def quant_leaf(leaf):

@@ -49,6 +49,40 @@ class TFDataset:
             "use orca.data XShards in place of FeatureSet on TPU")
 
     @classmethod
+    def from_image_set(cls, image_set, batch_size: int = -1, **kwargs):
+        """ImageSet -> dataset (reference tf_dataset.py:407); labels ride
+        along when present."""
+        x = image_set.to_array() if hasattr(image_set, "to_array") else \
+            np.stack([f.image for f in image_set.features])
+        y = None
+        feats = getattr(image_set, "features", None)
+        if feats and getattr(feats[0], "label", None) is not None:
+            y = np.asarray([f.label for f in feats])
+        return cls(x, y, batch_size)
+
+    @classmethod
+    def from_text_set(cls, text_set, batch_size: int = -1, **kwargs):
+        """TextSet (word2idx'd) -> dataset (reference tf_dataset.py:445)."""
+        x = np.stack([f.indices for f in text_set.features])
+        labels = [getattr(f, "label", None) for f in text_set.features]
+        y = (np.asarray(labels) if all(l is not None for l in labels)
+             else None)
+        return cls(x, y, batch_size)
+
+    @classmethod
+    def from_string_rdd(cls, string_rdd, batch_size: int = -1, **kwargs):
+        """Reference tf_dataset.py:550 wraps an RDD of strings; here any
+        iterable of strings becomes a (n,) object array."""
+        return cls(np.asarray(list(string_rdd), dtype=object), None,
+                   batch_size)
+
+    @classmethod
+    def from_bytes_rdd(cls, bytes_rdd, batch_size: int = -1, **kwargs):
+        """Reference tf_dataset.py:575 (TFBytesDataset)."""
+        return cls(np.asarray(list(bytes_rdd), dtype=object), None,
+                   batch_size)
+
+    @classmethod
     def from_tfrecord_file(cls, paths, feature_cols, label_cols=None,
                            batch_size: int = -1, **kwargs):
         """TFRecord corpus -> dataset (reference tf_dataset.py:480
@@ -153,6 +187,24 @@ class TFNet:
             "pipeline.inference.InferenceModel (load_tf) on TPU")
 
     from_session = from_export_folder
+
+
+def ZooOptimizer(optimizer, grad_accum_steps: int = 1):
+    """Gradient-accumulation wrapper (reference tfpark/zoo_optimizer.py wraps
+    a TF optimizer to sum grads over sub-batches before applying).
+
+    TPU-native: returns an optax transformation — ``optax.MultiSteps``
+    accumulates ``grad_accum_steps`` microbatch gradients on device and
+    applies one update, all inside the jitted train step. Pass the result
+    anywhere an optimizer is accepted (estimators, compile())."""
+    import optax
+
+    from ..orca.learn.optimizers.optimizers_impl import convert_optimizer
+    tx = convert_optimizer(optimizer)
+    if grad_accum_steps <= 1:
+        return tx
+    return optax.MultiSteps(
+        tx, every_k_schedule=grad_accum_steps).gradient_transformation()
 
 
 class TFEstimator:

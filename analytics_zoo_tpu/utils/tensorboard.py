@@ -45,15 +45,13 @@ def _masked_crc(data: bytes) -> int:
     return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
 
 
-# --- minimal protobuf encoding (wire helpers shared with the ONNX loader) ---
+# --- minimal protobuf encoding (wire helpers shared with the ONNX loader
+# and the TFRecord/Caffe codecs — one encoder set, utils/protostream.py) ---
 
 from analytics_zoo_tpu.utils.protostream import decode_fields as \
     _decode_fields  # noqa: E402
+from analytics_zoo_tpu.utils.protostream import pb_tag as _tag  # noqa: E402
 from analytics_zoo_tpu.utils.protostream import varint as _varint  # noqa
-
-
-def _tag(field: int, wire: int) -> bytes:
-    return _varint((field << 3) | wire)
 
 
 def _pb_double(field: int, v: float) -> bytes:

@@ -164,6 +164,11 @@ def bench_resnet50(smoke: bool) -> dict:
         peak_rate = sum(_peak_flops(d) for d in jax.devices())
         e2e = batch / dt_e2e / nchip
         comp = batch / dt_compute / nchip
+        # a real TPU host moves host->HBM at GB/s over PCIe/DMA; the
+        # tunneled dev chip has been observed anywhere from 7 to 50 MB/s.
+        # Flag runs where the streamed numbers measure the tunnel, not the
+        # framework (compute_* fields carry the chip-capability signal).
+        transfer_limited = bool(hot_mbps < 200.0)
         return {"metric": "resnet50_imagenet_train_throughput_per_chip",
                 "value": round(e2e, 1), "unit": "samples/sec/chip",
                 "vs_baseline": round(e2e / RESNET_BASELINE, 3),
@@ -174,6 +179,7 @@ def bench_resnet50(smoke: bool) -> dict:
                 "mfu_e2e": (round(step_flops / dt_e2e / peak_rate, 4)
                             if peak_rate else None),
                 "hot_transfer_MBps": round(hot_mbps, 1),
+                "transfer_limited": transfer_limited,
                 "batch": batch, "depth": depth, "crop": crop,
                 "streamed": True, "step_flops": step_flops}
     finally:
@@ -259,25 +265,24 @@ def bench_fraud_mlp(smoke: bool) -> dict:
                 x = nn.relu(nn.Dense(width)(x))
             return nn.sigmoid(nn.Dense(1)(x))[..., 0]
 
+    est = (NNEstimator(FraudMLP(), "binary_crossentropy")
+           .setBatchSize(batch).setMaxEpoch(epochs))
     if smoke:
-        est = (NNEstimator(FraudMLP(), "binary_crossentropy")
-               .setBatchSize(batch).setMaxEpoch(epochs))
         t0 = time.perf_counter()
         est.fit(df)
         dt = time.perf_counter() - t0
-        samples = n * epochs
     else:
-        # exclude one-time jit compile: time a 1-epoch and an (1+epochs)-
-        # epoch fit and take the difference (both pay the same compile)
+        # warm fit compiles the step; re-running fit on the SAME underlying
+        # engine (NNModel keeps it) measures steady-state epochs with the
+        # jit hot — no retrace, no recompile in the timed window
+        model = est.fit(df)
+        inner = model.estimator
         t0 = time.perf_counter()
-        (NNEstimator(FraudMLP(), "binary_crossentropy")
-         .setBatchSize(batch).setMaxEpoch(1).fit(df))
-        dt1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        (NNEstimator(FraudMLP(), "binary_crossentropy")
-         .setBatchSize(batch).setMaxEpoch(1 + epochs).fit(df))
-        dt = max(time.perf_counter() - t0 - dt1, 1e-6)
-        samples = n * epochs
+        inner.fit({"x": np.stack(df["features"].to_numpy()),
+                   "y": df["label"].to_numpy(np.float32)},
+                  epochs=epochs, batch_size=batch, verbose=False)
+        dt = time.perf_counter() - t0
+    samples = n * epochs
     per_chip = samples / dt / max(jax.device_count(), 1)
     # no published reference number; estimate: this 4-layer MLP on one A100
     # sustains ~8M samples/s (batch-bound) -> scaled constant like NCF's
@@ -405,7 +410,7 @@ def main():
                 detail = json.load(f)
         except Exception:
             detail = {}
-    detail["smoke"] = smoke
+    detail.pop("smoke", None)   # provenance is per-entry now
     for name, fn in benches.items():
         if only and name not in only:
             continue
@@ -413,6 +418,8 @@ def main():
             detail[name] = fn(smoke)
         except Exception as e:  # one failed workload must not hide the rest
             detail[name] = {"error": f"{type(e).__name__}: {e}"}
+        if isinstance(detail[name], dict):
+            detail[name]["smoke"] = smoke
 
     with open(detail_path, "w") as f:
         json.dump(detail, f, indent=2)

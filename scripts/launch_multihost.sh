@@ -38,11 +38,12 @@ read -ra HOST_ARR <<<"$HOSTS"
 NUM=${#HOST_ARR[@]}
 COORD="${HOST_ARR[0]}:${ZOO_COORDINATOR_PORT:-8476}"
 
+QUOTED=$(printf '%q ' "${PROGRAM[@]}")   # survive spaces/metachars over ssh
 pids=()
 for i in "${!HOST_ARR[@]}"; do
     ssh "${HOST_ARR[$i]}" \
         "ZOO_COORDINATOR=$COORD ZOO_NUM_PROCS=$NUM ZOO_PROC_ID=$i \
-         ${PROGRAM[*]}" &
+         $QUOTED" &
     pids+=($!)
 done
 rc=0

@@ -171,6 +171,66 @@ def test_tfpark_compat_facade(orca_context):
         TFDataset.from_rdd(None)
 
 
+def test_zoo_optimizer_grad_accumulation(orca_context):
+    """ZooOptimizer (reference tfpark/zoo_optimizer.py): grads accumulate
+    over k microbatches, one optimizer update per k steps — params must be
+    unchanged after k-1 steps and move on step k."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.orca.learn.engine import TrainEngine
+    from analytics_zoo_tpu.orca.learn.utils import Batch
+    from analytics_zoo_tpu.parallel import create_mesh
+    from analytics_zoo_tpu.tfpark import ZooOptimizer
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    mesh = create_mesh({"dp": -1})
+    tx = ZooOptimizer("sgd", grad_accum_steps=3)
+    eng = TrainEngine(Net(), tx, lambda y, p: (p - y) ** 2, {}, mesh)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 4).astype(np.float32)
+    y = rng.rand(16, 2).astype(np.float32)
+    eng.build((x,))
+    p0 = jax.device_get(eng.params)
+
+    def step():
+        return eng.train_batch(Batch(x=(jnp.asarray(x),),
+                                     y=(jnp.asarray(y),), w=None))
+
+    step()
+    step()
+    p2 = jax.device_get(eng.params)
+    np.testing.assert_allclose(
+        jax.tree_util.tree_leaves(p0)[0],
+        jax.tree_util.tree_leaves(p2)[0])       # no update before k steps
+    step()
+    p3 = jax.device_get(eng.params)
+    assert not np.allclose(jax.tree_util.tree_leaves(p0)[0],
+                           jax.tree_util.tree_leaves(p3)[0])
+
+
+def test_tfdataset_from_image_and_text_set(orca_context):
+    from analytics_zoo_tpu.feature.text.text_set import TextFeature, TextSet
+    from analytics_zoo_tpu.tfpark import TFDataset
+
+    feats = []
+    for i in range(4):
+        f = TextFeature(text=f"t {i}", label=i % 2)
+        f.indices = np.full(6, i, np.int32)
+        feats.append(f)
+    ds = TFDataset.from_text_set(TextSet(feats))
+    assert ds.x.shape == (4, 6)
+    assert ds.y.shape == (4,)
+
+    strings = TFDataset.from_string_rdd(["a", "b", "c"])
+    assert len(strings.x) == 3
+
+
 def test_tfpark_from_dataframe(orca_context):
     df = pd.DataFrame({"f": [[1.0, 2.0], [3.0, 4.0]], "l": [1.0, 2.0]})
     from analytics_zoo_tpu.tfpark import TFDataset
