@@ -51,13 +51,12 @@ class TFDataset:
     @classmethod
     def from_image_set(cls, image_set, batch_size: int = -1, **kwargs):
         """ImageSet -> dataset (reference tf_dataset.py:407); labels ride
-        along when present."""
-        x = image_set.to_array() if hasattr(image_set, "to_array") else \
-            np.stack([f.image for f in image_set.features])
-        y = None
-        feats = getattr(image_set, "features", None)
-        if feats and getattr(feats[0], "label", None) is not None:
-            y = np.asarray([f.label for f in feats])
+        along when present (feature.image.ImageSet stores images/labels via
+        get_image/get_label)."""
+        x = np.stack(image_set.get_image())
+        labels = image_set.get_label()
+        y = (np.asarray(labels)
+             if labels and all(l is not None for l in labels) else None)
         return cls(x, y, batch_size)
 
     @classmethod

@@ -230,6 +230,13 @@ def test_tfdataset_from_image_and_text_set(orca_context):
     strings = TFDataset.from_string_rdd(["a", "b", "c"])
     assert len(strings.x) == 3
 
+    from analytics_zoo_tpu.feature.image.imageset import ImageSet
+    imgs = np.random.RandomState(0).rand(5, 8, 8, 3).astype(np.float32)
+    iset = ImageSet.from_arrays(imgs, labels=np.arange(5))
+    ds2 = TFDataset.from_image_set(iset)
+    assert ds2.x.shape == (5, 8, 8, 3)
+    assert ds2.y.shape == (5,)
+
 
 def test_tfpark_from_dataframe(orca_context):
     df = pd.DataFrame({"f": [[1.0, 2.0], [3.0, 4.0]], "l": [1.0, 2.0]})
