@@ -27,6 +27,19 @@ def test_flash_matches_reference(causal):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_flash_block_autofit_stays_on_kernel():
+    """Default 512-tiles with a sequence divisible by 128 but not 512:
+    fit_block must shrink the tile (kernel path, no O(S^2) materialize)
+    and the numerics must still match the reference. s=640 > 512 and
+    640 % 512 != 0, so only the divisor ladder (640 % 128 == 0) keeps
+    this on the kernel."""
+    q, k, v = _qkv(s=640)
+    ref = mha_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)     # default 512x512 tiles
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_flash_grads_match_reference():
     q, k, v = _qkv(s=32)
 

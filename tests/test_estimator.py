@@ -53,6 +53,23 @@ def test_fit_xshards_and_predict(orca_context):
     assert arr.shape == (512,)
 
 
+def test_mixed_full_and_padded_batches(orca_context):
+    """512 rows at batch 100: five full batches ship w=None (weights
+    synthesized in-jit), the padded tail ships a mask — both signatures
+    must train/evaluate in one epoch and the eval count only real rows."""
+    from analytics_zoo_tpu.orca.learn.optimizers import Adam
+    x, y = make_linear_data()
+    est = Estimator.from_keras(linear_model_creator, loss="mse",
+                               optimizer=Adam(lr=0.05), metrics=["mae"])
+    stats = est.fit({"x": x, "y": y}, epochs=25, batch_size=100,
+                    verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+    assert stats[-1]["num_samples"] == 512     # masked tail not overcounted
+    result = est.evaluate({"x": x, "y": y}, batch_size=100)
+    assert result["num_samples"] == 512
+    assert result["loss"] < 1.0
+
+
 def test_pandas_xshards_fit(orca_context):
     import pandas as pd
     x, y = make_linear_data(256)
