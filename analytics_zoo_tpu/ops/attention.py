@@ -73,6 +73,59 @@ def blockwise_finalize(acc, l):
     return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        block_k: int = 512) -> jax.Array:
+    """Exact attention as a lax.scan over K/V blocks with the online
+    softmax — numerically identical to ``mha_reference`` but the S×S score
+    matrix never materializes (peak activation O(S·block_k) per head).
+
+    Each scan step is wrapped in ``jax.checkpoint``, so the backward pass
+    recomputes score tiles instead of storing them. Memory accounting
+    (honest version): the (Sq, Sk) score matrix never materializes, but
+    differentiating the scan still stores the (Sq, D) accumulator carry
+    per K block — peak residuals O(Sq * D * Sk / block_k), an
+    ~(block_k / D)x reduction vs materialized f32 scores (8x at D=64,
+    block_k=512), not fully linear. For truly linear-in-S training memory
+    shard the sequence instead (parallel/ring_attention.py). This is the
+    backward path behind ``flash_attention`` (the Pallas kernel handles
+    the forward; autodiff through it would need a transpose kernel)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    bk = block_k
+    while s_k % bk:
+        bk //= 2
+        if bk < 8:
+            bk = s_k
+            break
+    n_blocks = s_k // bk
+    k_blocks = jnp.moveaxis(k.reshape(b, n_blocks, bk, h, d), 1, 0)
+    v_blocks = jnp.moveaxis(v.reshape(b, n_blocks, bk, h, d), 1, 0)
+    # bottom-right-aligned causal mask, matching mha_reference's
+    # tril(k=s_k-s_q): with fewer queries than keys (decode), the last
+    # query attends to every key
+    q_pos = jnp.arange(s_q) + (s_k - s_q)
+
+    @jax.checkpoint
+    def step(carry, inputs):
+        acc, m, l = carry
+        k_blk, v_blk, k0 = inputs
+        acc, m, l = blockwise_update(
+            q, k_blk, v_blk, acc, m, l, sm_scale=sm_scale,
+            causal=causal, q_positions=q_pos,
+            k_positions=k0 + jnp.arange(bk))
+        return (acc, m, l), None
+
+    init = (jnp.zeros((b, s_q, h, d), jnp.float32),
+            jnp.full((b, s_q, h), NEG_INF, jnp.float32),
+            jnp.zeros((b, s_q, h), jnp.float32))
+    starts = jnp.arange(n_blocks) * bk
+    (acc, m, l), _ = lax.scan(step, init, (k_blocks, v_blocks, starts))
+    return blockwise_finalize(acc, l).astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Pallas TPU flash-attention kernel
 # ---------------------------------------------------------------------------
@@ -197,13 +250,16 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    # Recompute-based backward: XLA re-fuses the score matrix per tile; for
-    # very long sequences the ring path (parallel/ring_attention.py) keeps
-    # the working set at S_local per device instead.
+    # Blockwise-recompute backward: differentiate the scan-over-K-blocks
+    # form (jax.checkpoint per block) — score tiles recompute one
+    # (Sq, block_k) at a time, so the S x S matrix never rematerializes
+    # (scan carries still cost O(Sq*D) per block; see blockwise_attention's
+    # memory note).
     q, k, v = res
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
-                                         sm_scale=sm_scale), q, k, v)
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k),
+        q, k, v)
     return vjp(g)
 
 
