@@ -1,13 +1,23 @@
 from .imageset import ImageSet
 from .preprocessing import (ChainedPreprocessing, ImageAspectScale,
-                            ImageCenterCrop, ImageChannelNormalize, ImageHFlip,
-                            ImageMatToTensor, ImagePixelNormalizer,
+                            ImageBrightness, ImageBytesToMat,
+                            ImageCenterCrop, ImageChannelNormalize,
+                            ImageChannelOrder, ImageColorJitter, ImageExpand,
+                            ImageFiller, ImageFixedCrop, ImageHFlip,
+                            ImageHue, ImageMatToTensor, ImageMirror,
+                            ImagePixelNormalizer, ImageRandomAspectScale,
                             ImageRandomCrop, ImageRandomPreprocessing,
-                            ImageResize, ImageSetToSample, Preprocessing,
-                            imagenet_train_transforms, imagenet_val_transforms)
+                            ImageResize, ImageSaturation, ImageSetToSample,
+                            PerImageNormalize, Preprocessing,
+                            imagenet_train_transforms,
+                            imagenet_val_transforms)
 
 __all__ = ["ImageSet", "Preprocessing", "ChainedPreprocessing", "ImageResize",
-           "ImageAspectScale", "ImageCenterCrop", "ImageRandomCrop",
-           "ImageHFlip", "ImageChannelNormalize", "ImagePixelNormalizer",
+           "ImageAspectScale", "ImageRandomAspectScale", "ImageCenterCrop",
+           "ImageRandomCrop", "ImageFixedCrop", "ImageHFlip", "ImageMirror",
+           "ImageChannelNormalize", "ImagePixelNormalizer",
+           "PerImageNormalize", "ImageBrightness", "ImageSaturation",
+           "ImageHue", "ImageColorJitter", "ImageChannelOrder",
+           "ImageBytesToMat", "ImageExpand", "ImageFiller",
            "ImageRandomPreprocessing", "ImageMatToTensor", "ImageSetToSample",
            "imagenet_train_transforms", "imagenet_val_transforms"]

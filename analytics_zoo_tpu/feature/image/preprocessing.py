@@ -154,6 +154,209 @@ class ImagePixelNormalizer(ImageTransform):
         return img.astype(np.float32) - self.means
 
 
+class ImageBytesToMat(ImageTransform):
+    """Decode encoded image bytes (jpeg/png) to an HWC uint8 array
+    (reference: ImageBytesToMat). ``key_in`` selects the bytes field."""
+
+    def __init__(self, key_in: str = "bytes", key_out: str = "image"):
+        self.key_in, self.key_out = key_in, key_out
+
+    def apply(self, sample):
+        import cv2
+        buf = np.frombuffer(sample[self.key_in], np.uint8)
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        if img is None:
+            raise ValueError("cv2 could not decode image bytes")
+        out = dict(sample)
+        out[self.key_out] = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        return out
+
+
+class ImageBrightness(ImageTransform):
+    """Add a random brightness delta in [delta_low, delta_high]
+    (reference: ImageBrightness)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 rng: Optional[random.Random] = None):
+        self.lo, self.hi = delta_low, delta_high
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        delta = self.rng.uniform(self.lo, self.hi)
+        return np.clip(img.astype(np.float32) + delta, 0, 255)
+
+
+class ImageSaturation(ImageTransform):
+    """Scale saturation by a random factor (reference: ImageSaturation)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 rng: Optional[random.Random] = None):
+        self.lo, self.hi = delta_low, delta_high
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        import cv2
+        factor = self.rng.uniform(self.lo, self.hi)
+        hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_RGB2HSV).astype(
+            np.float32)
+        hsv[..., 1] = np.clip(hsv[..., 1] * factor, 0, 255)
+        return cv2.cvtColor(hsv.astype(np.uint8), cv2.COLOR_HSV2RGB)
+
+
+class ImageHue(ImageTransform):
+    """Shift hue by a random delta in degrees (reference: ImageHue)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 rng: Optional[random.Random] = None):
+        self.lo, self.hi = delta_low, delta_high
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        import cv2
+        delta = self.rng.uniform(self.lo, self.hi)
+        hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_RGB2HSV).astype(
+            np.float32)
+        hsv[..., 0] = (hsv[..., 0] + delta / 2.0) % 180.0  # cv2 H in [0,180)
+        return cv2.cvtColor(hsv.astype(np.uint8), cv2.COLOR_HSV2RGB)
+
+
+class ImageColorJitter(Preprocessing):
+    """Random brightness/saturation/hue in random order (reference:
+    ImageColorJitter composes the three with shuffle)."""
+
+    def __init__(self, brightness_prob: float = 0.5,
+                 saturation_prob: float = 0.5, hue_prob: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        rng = rng or random.Random()
+        self.rng = rng
+        self.stages = [
+            ImageRandomPreprocessing(ImageBrightness(rng=rng),
+                                     brightness_prob, rng=rng),
+            ImageRandomPreprocessing(ImageSaturation(rng=rng),
+                                     saturation_prob, rng=rng),
+            ImageRandomPreprocessing(ImageHue(rng=rng), hue_prob, rng=rng),
+        ]
+
+    def apply(self, sample):
+        order = list(self.stages)
+        self.rng.shuffle(order)
+        for t in order:
+            sample = t.apply(sample)
+        # dtype must not depend on which stage randomly ran last
+        # (brightness emits float32, saturation/hue emit uint8)
+        out = dict(sample)
+        out["image"] = np.clip(np.round(
+            sample["image"].astype(np.float32)), 0, 255).astype(np.uint8)
+        return out
+
+
+class ImageChannelOrder(ImageTransform):
+    """RGB <-> BGR (reference: ImageChannelOrder)."""
+
+    def transform_image(self, img):
+        return np.ascontiguousarray(img[..., ::-1])
+
+
+class PerImageNormalize(ImageTransform):
+    """Zero-mean/unit-variance per image (reference: PerImageNormalize,
+    tf.image.per_image_standardization semantics: std floored at
+    1/sqrt(num_pixels))."""
+
+    def transform_image(self, img):
+        img = img.astype(np.float32)
+        std = max(float(img.std()), 1.0 / float(np.sqrt(img.size)))
+        return (img - img.mean()) / std
+
+
+class ImageRandomAspectScale(ImageTransform):
+    """Aspect-preserving resize to a randomly chosen short side
+    (reference: ImageRandomAspectScale(min_sizes))."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000,
+                 rng: Optional[random.Random] = None):
+        self.scales = list(scales)
+        self.max_size = max_size
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        return ImageAspectScale(self.rng.choice(self.scales),
+                                self.max_size).transform_image(img)
+
+
+class ImageFixedCrop(ImageTransform):
+    """Crop a fixed region; normalized coords when ``normalized``
+    (reference: ImageFixedCrop(x1, y1, x2, y2, normalized))."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        x1, y1 = max(int(round(x1)), 0), max(int(round(y1)), 0)
+        x2, y2 = min(int(round(x2)), w), min(int(round(y2)), h)
+        return img[y1:y2, x1:x2]
+
+
+class ImageExpand(ImageTransform):
+    """Pad the image into a larger random canvas (SSD-style zoom-out;
+    reference: ImageExpand(means_r/g/b, max_expand_ratio))."""
+
+    def __init__(self, means=(123, 117, 104), max_expand_ratio: float = 4.0,
+                 rng: Optional[random.Random] = None):
+        self.means = np.asarray(means, np.float32)
+        self.max_ratio = max_expand_ratio
+        self.rng = rng or random.Random()
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        ratio = self.rng.uniform(1.0, self.max_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        top = self.rng.randint(0, nh - h)
+        left = self.rng.randint(0, nw - w)
+        canvas = np.empty((nh, nw, img.shape[2]), img.dtype)
+        canvas[...] = self.means.astype(img.dtype)
+        canvas[top:top + h, left:left + w] = img
+        return canvas
+
+
+class ImageFiller(ImageTransform):
+    """Fill a region with a constant value (reference: ImageFiller —
+    cutout-style occlusion)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 value: int = 255, normalized: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.value = value
+        self.normalized = normalized
+
+    def transform_image(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        # clamp + round like ImageFixedCrop: negative/out-of-range coords
+        # must fill the clipped region, not resolve to an empty slice
+        x1, y1 = max(int(round(x1)), 0), max(int(round(y1)), 0)
+        x2, y2 = min(int(round(x2)), w), min(int(round(y2)), h)
+        out = img.copy()
+        out[y1:y2, x1:x2] = self.value
+        return out
+
+
+class ImageMirror(ImageTransform):
+    """Unconditional horizontal mirror (reference: ImageMirror)."""
+
+    def transform_image(self, img):
+        return np.ascontiguousarray(img[:, ::-1])
+
+
 class ImageRandomPreprocessing(Preprocessing):
     """Apply inner transform with probability p (reference:
     ImageRandomPreprocessing)."""

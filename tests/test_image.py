@@ -31,6 +31,63 @@ def test_imageset_read_and_transform(orca_context, image_dir):
     assert imgs[0].dtype == np.float32
 
 
+def test_photometric_and_geometric_transforms():
+    """Round-3 transform additions (reference imagePreprocessing.py:
+    Brightness/Hue/Saturation/ColorJitter/Expand/FixedCrop/Filler/Mirror/
+    BytesToMat/PerImageNormalize): shape/dtype/value contracts."""
+    import cv2
+
+    from analytics_zoo_tpu.feature.image import (
+        ImageBrightness, ImageBytesToMat, ImageChannelOrder,
+        ImageColorJitter, ImageExpand, ImageFiller, ImageFixedCrop,
+        ImageHue, ImageMirror, ImageRandomAspectScale, ImageSaturation,
+        PerImageNormalize)
+
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (40, 60, 3), np.uint8)
+    sample = {"image": img}
+
+    out = ImageBrightness(10, 10).apply(sample)["image"]
+    np.testing.assert_allclose(out, np.clip(img.astype(np.float32) + 10,
+                                            0, 255))
+
+    assert ImageSaturation().apply(sample)["image"].shape == img.shape
+    assert ImageHue().apply(sample)["image"].shape == img.shape
+    assert ImageColorJitter().apply(sample)["image"].shape == img.shape
+
+    bgr = ImageChannelOrder().apply(sample)["image"]
+    np.testing.assert_array_equal(bgr[..., 0], img[..., 2])
+
+    norm = PerImageNormalize().apply(sample)["image"]
+    assert abs(float(norm.mean())) < 1e-4
+    assert 0.9 < float(norm.std()) <= 1.01
+
+    crop = ImageFixedCrop(0.25, 0.25, 0.75, 0.75).apply(sample)["image"]
+    assert crop.shape == (20, 30, 3)
+    crop_px = ImageFixedCrop(10, 5, 30, 25, normalized=False).apply(
+        sample)["image"]
+    assert crop_px.shape == (20, 20, 3)
+
+    big = ImageExpand(max_expand_ratio=2.0).apply(sample)["image"]
+    assert big.shape[0] >= 40 and big.shape[1] >= 60
+
+    filled = ImageFiller(0.0, 0.0, 0.5, 0.5, value=7).apply(
+        sample)["image"]
+    assert (filled[:20, :30] == 7).all()
+    np.testing.assert_array_equal(filled[20:], img[20:])
+
+    mirrored = ImageMirror().apply(sample)["image"]
+    np.testing.assert_array_equal(mirrored, img[:, ::-1])
+
+    scaled = ImageRandomAspectScale([20, 30]).apply(sample)["image"]
+    assert min(scaled.shape[:2]) in (20, 30)
+
+    ok, buf = cv2.imencode(".png", cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+    assert ok
+    decoded = ImageBytesToMat().apply({"bytes": buf.tobytes()})["image"]
+    np.testing.assert_array_equal(decoded, img)
+
+
 def test_imagenet_val_pipeline(orca_context):
     img = np.random.RandomState(1).randint(0, 255, (300, 400, 3), np.uint8)
     out = imagenet_val_transforms(224).apply({"image": img})
