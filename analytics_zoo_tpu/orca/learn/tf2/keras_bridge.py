@@ -30,6 +30,11 @@ _MERGE_KINDS = {"Add": "add", "Subtract": "sub", "Multiply": "mul",
                 "Concatenate": "concat"}
 
 
+def _dilation(cfg):
+    d = cfg.get("dilation_rate", 1)
+    return tuple(d) if isinstance(d, (list, tuple)) else (int(d),)
+
+
 def _spec_for(lyr) -> Optional[Dict[str, Any]]:
     """Spec dict for one keras layer; None for InputLayer; raises for
     unsupported types."""
@@ -46,13 +51,68 @@ def _spec_for(lyr) -> Optional[Dict[str, Any]]:
         return {"kind": "dense", "units": cfg["units"],
                 "activation": cfg.get("activation"),
                 "use_bias": cfg.get("use_bias", True), "name": lyr.name}
-    if isinstance(lyr, K.Conv2D):
+    _transpose_types = tuple(
+        t for t in (getattr(K, "Conv1DTranspose", None),
+                    getattr(K, "Conv2DTranspose", None),
+                    getattr(K, "Conv3DTranspose", None)) if t)
+    if isinstance(lyr, _transpose_types):
+        raise KerasConversionError(
+            f"transpose convolutions are not supported ('{lyr.name}'); "
+            "port the model to flax (nn.ConvTranspose)")
+    if isinstance(lyr, (K.Conv1D, K.Conv2D)) and not isinstance(
+            lyr, (K.DepthwiseConv2D, K.SeparableConv2D)):
         return {"kind": "conv2d", "filters": cfg["filters"],
                 "kernel": tuple(cfg["kernel_size"]),
                 "strides": tuple(cfg["strides"]),
                 "padding": cfg["padding"].upper(),
+                "dilation": _dilation(cfg),
                 "activation": cfg.get("activation"),
                 "use_bias": cfg.get("use_bias", True), "name": lyr.name}
+    if isinstance(lyr, K.DepthwiseConv2D):
+        return {"kind": "depthwise_conv2d",
+                "kernel": tuple(cfg["kernel_size"]),
+                "strides": tuple(cfg["strides"]),
+                "padding": cfg["padding"].upper(),
+                "dilation": _dilation(cfg),
+                "mult": cfg.get("depth_multiplier", 1),
+                "activation": cfg.get("activation"),
+                "use_bias": cfg.get("use_bias", True), "name": lyr.name}
+    if isinstance(lyr, K.SeparableConv2D):
+        return {"kind": "separable_conv2d", "filters": cfg["filters"],
+                "kernel": tuple(cfg["kernel_size"]),
+                "strides": tuple(cfg["strides"]),
+                "padding": cfg["padding"].upper(),
+                "dilation": _dilation(cfg),
+                "mult": cfg.get("depth_multiplier", 1),
+                "activation": cfg.get("activation"),
+                "use_bias": cfg.get("use_bias", True), "name": lyr.name}
+    if isinstance(lyr, K.UpSampling2D):
+        if cfg.get("interpolation", "nearest") != "nearest":
+            raise KerasConversionError(
+                f"UpSampling2D interpolation="
+                f"{cfg['interpolation']!r} ('{lyr.name}') is not supported "
+                "(nearest only); use jax.image.resize in a flax module")
+        return {"kind": "upsampling2d", "size": tuple(cfg["size"]),
+                "name": lyr.name}
+    if isinstance(lyr, K.ZeroPadding2D):
+        pad = cfg["padding"]
+        pad = ((pad, pad), (pad, pad)) if isinstance(pad, int) else \
+            tuple(tuple(p) if isinstance(p, (list, tuple)) else (p, p)
+                  for p in pad)
+        return {"kind": "zeropad2d", "padding": pad, "name": lyr.name}
+    if isinstance(lyr, K.GlobalMaxPooling2D):
+        return {"kind": "globalmaxpool",
+                "keepdims": bool(cfg.get("keepdims", False)),
+                "name": lyr.name}
+    if isinstance(lyr, K.MaxPooling1D):
+        return {"kind": "maxpool1d", "pool": int(cfg["pool_size"][0]
+                if isinstance(cfg["pool_size"], (list, tuple))
+                else cfg["pool_size"]),
+                "strides": int((cfg["strides"] or cfg["pool_size"])[0]
+                if isinstance(cfg["strides"] or cfg["pool_size"],
+                              (list, tuple))
+                else (cfg["strides"] or cfg["pool_size"])),
+                "padding": cfg["padding"].upper(), "name": lyr.name}
     if isinstance(lyr, K.BatchNormalization):
         return {"kind": "batchnorm", "eps": cfg["epsilon"],
                 "momentum": cfg["momentum"], "name": lyr.name}
@@ -71,7 +131,9 @@ def _spec_for(lyr) -> Optional[Dict[str, Any]]:
                 "strides": tuple(cfg["strides"] or cfg["pool_size"]),
                 "padding": cfg["padding"].upper(), "name": lyr.name}
     if isinstance(lyr, K.GlobalAveragePooling2D):
-        return {"kind": "globalavgpool", "name": lyr.name}
+        return {"kind": "globalavgpool",
+                "keepdims": bool(cfg.get("keepdims", False)),
+                "name": lyr.name}
     if isinstance(lyr, K.Embedding):
         return {"kind": "embedding", "num": cfg["input_dim"],
                 "dim": cfg["output_dim"], "name": lyr.name}
@@ -159,11 +221,37 @@ def _run_spec(s: Dict[str, Any], xs: list, nm: str, train: bool):
     if k == "dense":
         x = fnn.Dense(s["units"], use_bias=s["use_bias"], name=nm)(x)
         return _apply_act(x, s.get("activation"))
-    if k == "conv2d":
+    if k == "conv2d":                   # 1D and 2D convs (kernel rank)
         x = fnn.Conv(s["filters"], s["kernel"], s["strides"],
                      padding=s["padding"], use_bias=s["use_bias"],
-                     name=nm)(x)
+                     kernel_dilation=s.get("dilation"), name=nm)(x)
         return _apply_act(x, s.get("activation"))
+    if k == "depthwise_conv2d":
+        in_ch = x.shape[-1]
+        x = fnn.Conv(in_ch * s["mult"], s["kernel"], s["strides"],
+                     padding=s["padding"], use_bias=s["use_bias"],
+                     kernel_dilation=s.get("dilation"),
+                     feature_group_count=in_ch, name=nm)(x)
+        return _apply_act(x, s.get("activation"))
+    if k == "separable_conv2d":
+        in_ch = x.shape[-1]
+        x = fnn.Conv(in_ch * s["mult"], s["kernel"], s["strides"],
+                     padding=s["padding"], use_bias=False,
+                     kernel_dilation=s.get("dilation"),
+                     feature_group_count=in_ch, name=f"{nm}_dw")(x)
+        x = fnn.Conv(s["filters"], (1, 1), use_bias=s["use_bias"],
+                     name=f"{nm}_pw")(x)
+        return _apply_act(x, s.get("activation"))
+    if k == "upsampling2d":
+        sh, sw = s["size"]
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+    if k == "zeropad2d":
+        (t, b), (l, r) = s["padding"]
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+    if k == "globalmaxpool":
+        return x.max(axis=(1, 2), keepdims=s.get("keepdims", False))
+    if k == "maxpool1d":
+        return fnn.max_pool(x, (s["pool"],), (s["strides"],), s["padding"])
     if k == "batchnorm":
         return fnn.BatchNorm(use_running_average=not train,
                              momentum=s["momentum"], epsilon=s["eps"],
@@ -180,7 +268,7 @@ def _run_spec(s: Dict[str, Any], xs: list, nm: str, train: bool):
     if k == "avgpool":
         return fnn.avg_pool(x, s["pool"], s["strides"], s["padding"])
     if k == "globalavgpool":
-        return x.mean(axis=(1, 2))
+        return x.mean(axis=(1, 2), keepdims=s.get("keepdims", False))
     if k == "embedding":
         return fnn.Embed(s["num"], s["dim"], name=nm)(x.astype(jnp.int32))
     if k == "act":
@@ -251,10 +339,24 @@ def _load_spec_weights(params, batch_stats, s, nm, w):
     k = s["kind"]
     if not w:
         return
-    if k in ("dense", "conv2d"):
+    if k in ("dense", "conv2d"):        # conv2d covers 1D convs too
         params[nm] = {"kernel": w[0]}
         if s["use_bias"] and len(w) > 1:
             params[nm]["bias"] = w[1]
+    elif k == "depthwise_conv2d":
+        # keras depthwise kernel (kh, kw, in, mult) -> flax grouped-conv
+        # kernel (kh, kw, 1, in*mult); reshape is in-major, matching flax's
+        # per-group output ordering
+        dw = w[0]
+        params[nm] = {"kernel": dw.reshape(*dw.shape[:2], 1, -1)}
+        if s["use_bias"] and len(w) > 1:
+            params[nm]["bias"] = w[1]
+    elif k == "separable_conv2d":
+        dw, pw = w[0], w[1]
+        params[f"{nm}_dw"] = {"kernel": dw.reshape(*dw.shape[:2], 1, -1)}
+        params[f"{nm}_pw"] = {"kernel": pw}
+        if s["use_bias"] and len(w) > 2:
+            params[f"{nm}_pw"]["bias"] = w[2]
     elif k == "batchnorm":
         params[nm] = {"scale": w[0], "bias": w[1]}
         batch_stats[nm] = {"mean": w[2], "var": w[3]}

@@ -134,3 +134,11 @@ class ClusterServing:
         """(reference observability: Flink numRecordsOutPerSecond +
         Timer stats)"""
         return {"records_out": self.records_out, "stages": self.timer.summary()}
+
+    def update_model(self, model: InferenceModel):
+        """Hot-swap the served model (the reference rolls a new model by
+        restarting the Flink job, ClusterServingGuide 'model update'; here
+        the swap is a reference assignment — in-flight batches finish on
+        the old executables, the next claim uses the new ones)."""
+        self.model = model
+        return self

@@ -232,6 +232,55 @@ def test_keras_functional_branching_graph(orca_context):
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
 
 
+def test_keras_extended_layer_set(orca_context):
+    """Round-3 keras-bridge additions: Conv1D / DepthwiseConv2D /
+    SeparableConv2D / UpSampling2D / ZeroPadding2D / GlobalMaxPooling2D
+    convert with exact weights (numerics vs tf inference)."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.orca.learn.tf2.keras_bridge import (
+        build_flax_from_keras)
+
+    rng = np.random.RandomState(11)
+
+    model2d = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(16, 16, 3)),
+        tf.keras.layers.ZeroPadding2D(1),
+        tf.keras.layers.DepthwiseConv2D(3, depth_multiplier=2,
+                                        activation="relu"),
+        tf.keras.layers.SeparableConv2D(8, 3, padding="same"),
+        tf.keras.layers.UpSampling2D(2),
+        tf.keras.layers.GlobalMaxPooling2D(),
+        tf.keras.layers.Dense(4)])
+    x = rng.rand(2, 16, 16, 3).astype(np.float32)
+    expected = model2d(x).numpy()
+    mod, loader = build_flax_from_keras(model2d)
+    variables = loader(mod.init(jax.random.PRNGKey(0), x))
+    got = np.asarray(mod.apply(variables, x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    model1d = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(20, 5)),
+        tf.keras.layers.Conv1D(8, 3, dilation_rate=2, activation="relu"),
+        tf.keras.layers.MaxPooling1D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(3)])
+    x1 = rng.rand(2, 20, 5).astype(np.float32)
+    expected1 = model1d(x1).numpy()
+    mod1, loader1 = build_flax_from_keras(model1d)
+    variables1 = loader1(mod1.init(jax.random.PRNGKey(0), x1))
+    np.testing.assert_allclose(np.asarray(mod1.apply(variables1, x1)),
+                               expected1, rtol=1e-4, atol=1e-5)
+
+    # silently-divergent configs must raise instead
+    from analytics_zoo_tpu.orca.learn.tf2.keras_bridge import (
+        KerasConversionError)
+    bad = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8, 8, 3)),
+        tf.keras.layers.UpSampling2D(2, interpolation="bilinear")])
+    with pytest.raises(KerasConversionError):
+        build_flax_from_keras(bad)
+
+
 def test_keras_multi_input_graph(orca_context):
     """Two-input functional model (wide & deep shape) through the DAG."""
     tf = pytest.importorskip("tensorflow")

@@ -90,6 +90,38 @@ def test_cluster_serving_end_to_end(orca_context):
         serving.stop()
 
 
+def test_hot_model_swap(orca_context):
+    """update_model swaps the served model without restarting the engine
+    (reference rolls a new Flink job; here it's a reference swap)."""
+    import flax.linen as nn
+    import jax
+
+    class Net(nn.Module):
+        bias: float = 0.0
+
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x) + self.bias
+
+    def make(bias):
+        m = Net(bias=bias)
+        v = m.init(jax.random.PRNGKey(0), np.zeros((1, 3), np.float32))
+        return InferenceModel().load_jax(m, v)
+
+    broker = InMemoryBroker()
+    serving = ClusterServing(make(0.0), queue=broker, batch_size=4,
+                             batch_timeout_ms=10).start()
+    try:
+        iq = InputQueue(queue=broker)
+        x = np.ones(3, np.float32)
+        before = np.asarray(iq.predict(x, timeout_s=10))
+        serving.update_model(make(100.0))
+        after = np.asarray(iq.predict(x, timeout_s=10))
+        np.testing.assert_allclose(after, before + 100.0, rtol=1e-5)
+    finally:
+        serving.stop()
+
+
 def test_int8_quantization(orca_context):
     """Weight-only int8: ~4x smaller resident weights, predictions within
     the reference's accuracy envelope (wp-bigdl.md:192 int8 claims)."""
