@@ -97,6 +97,27 @@ def _cat(tensors, dim=0):
     return jnp.concatenate(tensors, axis=dim)
 
 
+def _f_pad(x, pad, mode="constant", value=0.0):
+    """torch.nn.functional.pad: `pad` lists (left, right) pairs starting
+    from the LAST dimension."""
+    import jax.numpy as jnp
+    if mode != "constant":
+        from .torch_bridge import TorchConversionError
+        raise TorchConversionError(
+            f"F.pad mode={mode!r} is not supported (constant only)")
+    if any(int(p) < 0 for p in pad):
+        # torch treats negative pad as cropping; reject loudly rather than
+        # letting jnp.pad raise an opaque ValueError at apply time
+        from .torch_bridge import TorchConversionError
+        raise TorchConversionError(
+            f"F.pad with negative (cropping) widths {tuple(pad)} is not "
+            "supported; slice the tensor instead")
+    widths = [(0, 0)] * x.ndim
+    for i in range(len(pad) // 2):
+        widths[x.ndim - 1 - i] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    return jnp.pad(x, widths, constant_values=value)
+
+
 def _build_function_table() -> Dict[Any, Callable]:
     import jax
     import jax.numpy as jnp
@@ -112,6 +133,9 @@ def _build_function_table() -> Dict[Any, Callable]:
         operator.imul: operator.mul, operator.truediv: operator.truediv,
         operator.neg: operator.neg, operator.getitem: operator.getitem,
         operator.matmul: jnp.matmul,
+        operator.gt: operator.gt, operator.lt: operator.lt,
+        operator.ge: operator.ge, operator.le: operator.le,
+        operator.eq: operator.eq, operator.ne: operator.ne,
         torch.add: lambda a, b, alpha=1: a + alpha * b,
         torch.sub: lambda a, b, alpha=1: a - alpha * b,
         torch.mul: operator.mul,
@@ -157,8 +181,37 @@ def _build_function_table() -> Dict[Any, Callable]:
         F.normalize: lambda x, p=2.0, dim=1, eps=1e-12:
             x / jnp.maximum(jnp.linalg.norm(x, ord=p, axis=dim,
                                             keepdims=True), eps),
+        torch.clamp: lambda x, min=None, max=None: jnp.clip(x, min, max),
+        torch.pow: lambda x, p: x ** p,
+        operator.pow: operator.pow,
+        torch.sqrt: jnp.sqrt,
+        torch.rsqrt: lambda x: 1.0 / jnp.sqrt(x),
+        torch.abs: jnp.abs,
+        torch.minimum: jnp.minimum,
+        torch.maximum: jnp.maximum,
+        torch.where: jnp.where,
+        torch.log: jnp.log,
+        torch.log1p: jnp.log1p,
+        torch.erf: lambda x: jax.scipy.special.erf(x),
+        F.pad: _f_pad,
+        F.dropout: _f_dropout,
     }
     return table
+
+
+def _f_dropout(x, p=0.5, training=False, inplace=False):
+    """F.dropout converts as identity ONLY when the traced training flag is
+    False — fx concretizes `training=self.training` at trace time, and a
+    silently-dropped train-mode dropout would change training dynamics.
+    Use nn.Dropout modules for convertible dropout (they map to flax
+    Dropout honoring the train flag)."""
+    if training:
+        from .torch_bridge import TorchConversionError
+        raise TorchConversionError(
+            "F.dropout(..., training=True) cannot be converted (the traced "
+            "flag is baked in); use an nn.Dropout module instead, which "
+            "maps to flax Dropout")
+    return x
 
 
 _METHODS: Dict[str, Callable] = {}

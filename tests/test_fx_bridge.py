@@ -137,6 +137,22 @@ def test_grouped_conv_supported_via_fx():
     _convert_and_compare(Net(), x)
 
 
+def test_fx_elementwise_op_breadth():
+    """clamp/pow/sqrt/abs/min/max/where/pad/log map 1:1 to jnp and must
+    match torch numerics through the tracer."""
+    class Net(tnn.Module):
+        def forward(self, x):
+            a = torch.clamp(x, 0.1, 0.9)
+            b = torch.sqrt(torch.abs(x) + 1.0) + torch.pow(a, 2)
+            c = torch.maximum(a, b) - torch.minimum(a, b)
+            d = torch.where(x > 0.5, c, torch.log1p(a))
+            return F.pad(d, (1, 2), value=3.0)
+
+    rng = np.random.RandomState(12)
+    x = rng.rand(3, 6).astype(np.float32)
+    _convert_and_compare(Net(), x)
+
+
 def test_unsupported_op_names_the_node():
     class Net(tnn.Module):
         def forward(self, x):
