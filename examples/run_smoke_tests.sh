@@ -13,6 +13,7 @@ for script in \
     examples/orca/learn/resnet50_imagenet.py \
     examples/nnframes/fraud_detection_mlp.py \
     examples/zouwu/autots_forecast.py \
+    examples/tfpark/bert_intent_classification.py \
     examples/serving/object_detection_serving.py; do
   echo "=== $script --smoke"
   python "$script" --smoke
