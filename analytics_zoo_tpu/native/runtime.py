@@ -167,14 +167,20 @@ class Arena:
             # Python-object construction happens OUTSIDE the critical
             # section (it can trigger GC → view finalizers); the count is
             # already reserved, so a concurrent close() stays deferred.
+            fin = None
             try:
                 buf = (ctypes.c_char * nbytes).from_address(ptr)
                 # the array's .base chain ends at `buf`; pinning the Arena
                 # on it keeps the native block alive while any view exists
                 buf._zoo_arena = self
-                weakref.finalize(buf, self._on_view_dead)
+                fin = weakref.finalize(buf, self._on_view_dead)
                 return np.frombuffer(buf, dtype=dtype).reshape(shape)
             except BaseException:
+                # detach the finalizer before the manual rollback so the
+                # reservation is only ever decremented once (a live finalizer
+                # would fire again at buf collection — double-decrement)
+                if fin is not None:
+                    fin.detach()
                 self._on_view_dead()  # roll back the reservation
                 raise
         return np.empty(shape, dtype)

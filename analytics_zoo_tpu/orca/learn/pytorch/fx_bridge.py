@@ -435,9 +435,14 @@ def build_flax_from_torch_fx(module):
                 return fnn.Dense(sub.out_features,
                                  use_bias=sub.bias is not None, name=nm)(x)
             if isinstance(sub, tnn.Conv2d):
+                # kernel is stored OIHW (torch layout); lecun_normal assumes
+                # (..., fan_in, fan_out) so fan axes must be given explicitly:
+                # fan_in = in_channels/groups * kh * kw (axes 1,2,3), out = 0
                 kernel = self.param(
                     nm + "_kernel",
-                    fnn.initializers.lecun_normal(),
+                    fnn.initializers.variance_scaling(
+                        1.0, "fan_in", "truncated_normal",
+                        in_axis=(1, 2, 3), out_axis=0),
                     (sub.out_channels, sub.in_channels // sub.groups,
                      *_pair(sub.kernel_size)))
                 y = _conv2d_nchw(x, kernel, sub.stride, sub.padding,

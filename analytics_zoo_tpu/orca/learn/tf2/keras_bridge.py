@@ -7,11 +7,13 @@ translated once, on the driver, into flax + optax + our losses/metrics (layer
 configs and weights are introspectable; keras is already NHWC so no layout
 gymnastics), and the jitted engine trains it on TPU.
 
-Coverage: Sequential / linear Functional graphs over Dense, Conv2D,
-BatchNormalization, LayerNormalization, Dropout, Flatten, MaxPooling2D,
-AveragePooling2D, GlobalAveragePooling2D, Embedding, Activation, ReLU,
-Softmax, InputLayer. Branching functional graphs and custom layers raise with
-porting guidance (write the model as a flax module instead).
+Coverage: Sequential and Functional graphs — including branching/merge
+topologies (Add/Subtract/Multiply/Average/Maximum/Minimum/Concatenate, see
+``build_flax_from_keras_graph``) — over Dense, Conv2D, BatchNormalization,
+LayerNormalization, Dropout, Flatten, MaxPooling2D, AveragePooling2D,
+GlobalAveragePooling2D, Embedding, Activation, ReLU, Softmax, InputLayer.
+Custom layers raise with porting guidance (write the model as a flax module
+instead).
 """
 
 from __future__ import annotations

@@ -213,6 +213,31 @@ class InferenceModel:
         return self
 
     # --- predict ------------------------------------------------------------
+    def precompile(self, example, max_bucket: Optional[int] = None
+                   ) -> "InferenceModel":
+        """Compile the executable for every shape bucket up front.
+
+        The reference pre-copies model replicas into a blocking queue before
+        serving starts so no request pays model-setup cost
+        (InferenceModel.scala:580-626); the XLA analogue of that cost is
+        per-bucket compilation, which otherwise lands in the latency tail of
+        whichever unlucky request first hits each bucket (e.g. timeout-sized
+        partial batches).
+
+        ``example`` is a batch (leading dim = batch, any size); every bucket
+        <= ``max_bucket`` (default: all buckets) is compiled by running a
+        zero-filled batch of exactly the bucket size through ``predict``,
+        warming exactly the cache the serving path uses.
+        """
+        multi = isinstance(example, (list, tuple))
+        xs = [np.asarray(a) for a in (example if multi else [example])]
+        for b in self.buckets:
+            if max_bucket is not None and b > max_bucket:
+                break
+            probe = [np.zeros((b,) + a.shape[1:], a.dtype) for a in xs]
+            self.predict(probe if multi else probe[0])
+        return self
+
     def predict(self, inputs) -> np.ndarray:
         """Batch predict with shape bucketing + executable cache (replaces the
         model-copy queue, InferenceModel.scala:580-626)."""

@@ -28,12 +28,39 @@ class API:
 
 
 class InputQueue(API):
+    def __init__(self, queue: str = "memory://serving_stream",
+                 host: Optional[str] = None, port=None,
+                 name: str = "serving_stream",
+                 max_pending: Optional[int] = None,
+                 backpressure_poll_s: float = 0.002):
+        """``max_pending`` caps the broker backlog: enqueue blocks while
+        ``pending() >= max_pending``, so a burst of producers cannot grow the
+        queue (and the tail latency of everything behind it) without bound.
+        The reference relies on Flink backpressure for the same effect."""
+        super().__init__(queue, host, port, name)
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.max_pending = max_pending
+        self._poll_s = backpressure_poll_s
+        # pending() costs a round trip on the Redis transport; only re-query
+        # once the locally-sent count could plausibly have reached the cap
+        self._last_pending = 0
+        self._sent_since = 0
+
     def enqueue(self, uri: str, **data) -> str:
         """enqueue(uri, t=ndarray) or multiple named tensors
         (reference: client.py:144-233)."""
         if not data:
             raise ValueError("provide at least one named tensor, e.g. "
                              "input_api.enqueue('my-id', t=arr)")
+        if self.max_pending is not None:
+            import time as _time
+            while self._last_pending + self._sent_since >= self.max_pending:
+                self._last_pending = self.broker.pending()
+                self._sent_since = 0
+                if self._last_pending >= self.max_pending:
+                    _time.sleep(self._poll_s)
+            self._sent_since += 1
         if len(data) == 1:
             payload = encode_payload(np.asarray(next(iter(data.values()))),
                                      meta={"uri": uri})

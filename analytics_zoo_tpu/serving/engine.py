@@ -51,8 +51,15 @@ class Timer:
         for name, vals in self.stats.items():
             arr = np.asarray(vals)
             out[name] = {"count": len(arr), "mean_ms": float(arr.mean() * 1e3),
+                         "p50_ms": float(np.percentile(arr, 50) * 1e3),
+                         "p95_ms": float(np.percentile(arr, 95) * 1e3),
                          "p99_ms": float(np.percentile(arr, 99) * 1e3)}
         return out
+
+    def reset(self):
+        """Drop accumulated samples (e.g. after warmup, so reported
+        percentiles are steady-state rather than compile-tainted)."""
+        self.stats = defaultdict(list)
 
 
 class ClusterServing:
@@ -117,7 +124,16 @@ class ClusterServing:
         self.records_out += len(batch)
 
     # --- lifecycle ----------------------------------------------------------
-    def start(self):
+    def start(self, example=None):
+        """Start worker threads. With ``example`` (a batch-shaped array, or
+        list of arrays, matching real traffic's record shape/dtype), every
+        shape bucket up to ``batch_size`` is compiled BEFORE serving begins —
+        the XLA analogue of the reference pre-filling its model-copy queue
+        (InferenceModel.scala:580-626). Without it, timeout-sized partial
+        batches hit cold buckets and compiles land in the latency tail."""
+        if example is not None:
+            with self.timer.time("precompile"):
+                self.model.precompile(example, max_bucket=self.batch_size)
         for i in range(self.num_workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"serving-worker-{i}")
@@ -134,6 +150,12 @@ class ClusterServing:
         """(reference observability: Flink numRecordsOutPerSecond +
         Timer stats)"""
         return {"records_out": self.records_out, "stages": self.timer.summary()}
+
+    def reset_metrics(self):
+        """Zero the stage timers and record counter — call after warmup so
+        ``metrics()`` reports steady-state percentiles."""
+        self.timer.reset()
+        self.records_out = 0
 
     def update_model(self, model: InferenceModel):
         """Hot-swap the served model (the reference rolls a new model by

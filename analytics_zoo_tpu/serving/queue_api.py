@@ -164,7 +164,10 @@ class RedisBroker(Broker):
 
     Input records are XADDed to ``<stream>`` with fields ``uri``/``data``;
     the engine side claims them with XREADGROUP on consumer group ``group``
-    and XACKs after hand-off. Results go to hash ``result:<id>`` field
+    and XACKs/XDELs only after the result is published (``put_result``), so
+    a worker that crashes mid-inference leaves its claims in the group PEL
+    where XAUTOCLAIM steals them — at-least-once delivery end to end.
+    Results go to hash ``result:<id>`` field
     ``value`` (reference sink pipelines HSETs, FlinkRedisSink.scala:29) and
     are deleted on read, matching the reference client's get-then-forget
     polling loop (pyzoo client.py:250-282).
@@ -194,6 +197,11 @@ class RedisBroker(Broker):
         # a live consumer, restoring at-least-once delivery.
         self._claim_idle_ms = claim_idle_ms
         self._last_autoclaim = 0.0
+        # entry ids claimed but not yet acked: acked/deleted only after the
+        # result is published (put_result), so a worker that dies mid-batch
+        # leaves its entries in the group PEL where XAUTOCLAIM can steal them
+        self._pending_acks: Dict[str, List[bytes]] = {}
+        self._pending_lock = threading.Lock()
         try:
             self._conn().execute("XGROUP", "CREATE", self.stream, self.group,
                                  "0", "MKSTREAM")
@@ -249,15 +257,26 @@ class RedisBroker(Broker):
                     batch.append((kv[b"uri"].decode(), kv[b"data"]))
                     ids.append(eid)
         if ids:
-            c.execute("XACK", self.stream, self.group, *ids)
-            # trim processed entries so the stream doesn't grow unboundedly
-            # and XLEN keeps meaning "backlog" like the other brokers
-            c.execute("XDEL", self.stream, *ids)
+            with self._pending_lock:
+                for (item_id, _), eid in zip(batch, ids):
+                    self._pending_acks.setdefault(item_id, []).append(eid)
         return batch
 
     def put_result(self, item_id, payload):
-        self._conn().execute("HSET", b"result:" + item_id.encode(),
-                             "value", payload)
+        c = self._conn()
+        c.execute("HSET", b"result:" + item_id.encode(), "value", payload)
+        # ack + trim only now that the result is durably published; entries
+        # for crashed workers stay in the PEL until XAUTOCLAIM steals them.
+        # One entry per call: if the same uri was enqueued twice, each copy's
+        # ack waits for its own result, preserving at-least-once per entry.
+        with self._pending_lock:
+            eids = self._pending_acks.get(item_id)
+            eid = eids.pop(0) if eids else None
+            if eids is not None and not eids:
+                del self._pending_acks[item_id]
+        if eid is not None:
+            c.execute("XACK", self.stream, self.group, eid)
+            c.execute("XDEL", self.stream, eid)
 
     def get_result(self, item_id, timeout_s=10.0):
         key = b"result:" + item_id.encode()
@@ -273,7 +292,17 @@ class RedisBroker(Broker):
             time.sleep(0.005)
 
     def pending(self):
-        return int(self._conn().execute("XLEN", self.stream))
+        """Backlog = stream length minus claimed-but-unacked entries, so it
+        means the same thing as the other brokers' pending() (entries now
+        stay in the stream until their result publishes)."""
+        c = self._conn()
+        backlog = int(c.execute("XLEN", self.stream))
+        try:
+            p = c.execute("XPENDING", self.stream, self.group)
+            in_flight = int(p[0]) if p else 0
+        except self._RedisError:
+            in_flight = 0
+        return max(backlog - in_flight, 0)
 
     def close(self):
         with self._clients_lock:

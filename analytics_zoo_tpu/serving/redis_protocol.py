@@ -384,6 +384,24 @@ class _Handler(socketserver.BaseRequestHandler):
                         g["next"] -= drop
         self._int(n)
 
+    def _cmd_xpending(self, st, args):
+        # XPENDING key group — summary form: [count, min-id, max-id,
+        # [[consumer, count-as-string], ...]]
+        key, group = args[0], args[1]
+        with st.cv:
+            s = st.streams.get(key)
+            g = s.groups.get(group) if s else None
+            pel = dict(g["pel"]) if g else {}
+        if not pel:
+            self._array([0, None, None, None])
+            return
+        ids = sorted(pel)
+        per: Dict[bytes, int] = {}
+        for _eid, (consumer, _t) in pel.items():
+            per[consumer] = per.get(consumer, 0) + 1
+        self._array([len(pel), ids[0], ids[-1],
+                     [[c, str(n).encode()] for c, n in sorted(per.items())]])
+
     def _cmd_xack(self, st, args):
         key, group, ids = args[0], args[1], args[2:]
         n = 0

@@ -72,9 +72,15 @@ class TCMF:
         self.y_mean = None
         self.y_scale = None
 
-    def _loss(self, F, X, net_params, y):
+    def _loss(self, F, X, net_params, y, mask=None):
         recon = F @ X                                     # (n, T)
-        mse = jnp.mean((recon - y) ** 2)
+        if mask is None:
+            mse = jnp.mean((recon - y) ** 2)
+        else:
+            # padded rows (mesh-divisibility padding) carry mask 0 and must
+            # not contribute to the loss or its denominator
+            mse = (jnp.sum((recon - y) ** 2 * mask[:, None])
+                   / (jnp.sum(mask) * y.shape[1]))
         T = X.shape[1]
         w = self.window
         # one-step TCN prior on X
@@ -114,7 +120,14 @@ class TCMF:
         return mse + self.lam * (temporal + closed)
 
     def fit(self, y: np.ndarray, epochs: int = 100,
-            val_len: int = 0) -> Dict[str, float]:
+            val_len: int = 0, mesh=None) -> Dict[str, float]:
+        """With ``mesh``, the series dimension n — the factorization matrix F
+        (n, rank), the observations Y (n, T) and their Adam moments — is
+        sharded over the mesh's dp/fsdp axes, so corpora beyond one chip's
+        HBM train like the reference's distributed TCMF (DeepGLO.py:904
+        spreads the factorization across Orca workers). X and the TCN stay
+        replicated (they are rank-sized); XLA inserts the psum for the
+        reconstruction-loss reduction."""
         y = np.asarray(y, np.float32)
         n, T = y.shape
         if T <= self.window + 1:
@@ -122,30 +135,64 @@ class TCMF:
                              f"{self.window}")
         self.y_mean = y.mean(axis=1, keepdims=True)
         self.y_scale = y.std(axis=1, keepdims=True) + 1e-6
-        yn = jnp.asarray((y - self.y_mean) / self.y_scale)
+        yn_host = ((y - self.y_mean) / self.y_scale).astype(np.float32)
+
+        ndev = 1
+        if mesh is not None:
+            axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+            ndev = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+        self._n = n
+        mask = None
+        if ndev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            n_pad = -(-n // ndev) * ndev
+            row_axis = axes if len(axes) > 1 else axes[0]
+            if n_pad > n:
+                yn_host = np.concatenate(
+                    [yn_host, np.zeros((n_pad - n, T), np.float32)])
+            mask_host = (np.arange(n_pad) < n).astype(np.float32)
+            row2d = NamedSharding(mesh, P(row_axis, None))
+            yn = jax.device_put(yn_host, row2d)
+            mask = jax.device_put(mask_host, NamedSharding(mesh, P(row_axis)))
+        else:
+            n_pad = n
+            yn = jnp.asarray(yn_host)
 
         rng = jax.random.PRNGKey(self.seed)
         kF, kX, kN = jax.random.split(rng, 3)
-        F = jax.random.normal(kF, (n, self.rank)) * 0.1
+        F = jax.random.normal(kF, (n_pad, self.rank)) * 0.1
         X = jax.random.normal(kX, (self.rank, T)) * 0.1
         net_params = self.net.init(
             {"params": kN}, jnp.zeros((1, self.window, self.rank)))["params"]
+        if ndev > 1:
+            F = jax.device_put(F, row2d)
+            repl = NamedSharding(mesh, P())
+            X = jax.device_put(X, repl)
+            net_params = jax.device_put(net_params, repl)
 
         tx = optax.adam(self.lr)
         params = {"F": F, "X": X, "net": net_params}
-        opt_state = tx.init(params)
+        # init under jit so the Adam moments inherit each leaf's sharding
+        opt_state = jax.jit(tx.init)(params)
 
+        # the whole epoch loop is ONE lax.scan inside ONE jitted program:
+        # no per-step dispatch, and (mesh path) no unbounded queue of
+        # collective executions — XLA compiles the step body once and the
+        # chip runs all epochs back-to-back
         @jax.jit
-        def step(params, opt_state):
-            def loss_of(p):
-                return self._loss(p["F"], p["X"], p["net"], yn)
-            loss, grads = jax.value_and_grad(loss_of)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+        def run(params, opt_state):
+            def body(carry, _):
+                params, opt_state = carry
+                def loss_of(p):
+                    return self._loss(p["F"], p["X"], p["net"], yn, mask)
+                loss, grads = jax.value_and_grad(loss_of)(params)
+                updates, opt_state2 = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state2), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=epochs)
+            return params, opt_state, losses[-1]
 
-        loss = None
-        for _ in range(epochs):
-            params, opt_state, loss = step(params, opt_state)
+        params, opt_state, loss = run(params, opt_state)
         self.F = params["F"]
         self.X = params["X"]
         self.net_params = params["net"]
@@ -157,8 +204,17 @@ class TCMF:
         if self.F is None:
             raise RuntimeError("call fit before fit_incremental")
         y_new = np.asarray(y_new, np.float32)
-        yn_new = jnp.asarray((y_new - self.y_mean) / self.y_scale)
+        yn_host = ((y_new - self.y_mean) / self.y_scale).astype(np.float32)
         T_new = y_new.shape[1]
+        n_pad = int(self.F.shape[0])
+        mask = None
+        if n_pad > yn_host.shape[0]:   # fit() padded F for mesh divisibility
+            mask = jnp.asarray(
+                (np.arange(n_pad) < yn_host.shape[0]).astype(np.float32))
+            yn_host = np.concatenate(
+                [yn_host,
+                 np.zeros((n_pad - yn_host.shape[0], T_new), np.float32)])
+        yn_new = jnp.asarray(yn_host)
         # init new X columns by rolling the TCN forward
         x_roll = self._roll(T_new)
         X_full = jnp.concatenate([self.X, x_roll], axis=1)
@@ -169,17 +225,23 @@ class TCMF:
         T_old = self.X.shape[1]
 
         @jax.jit
-        def step(params, opt_state):
-            def loss_of(p):
-                recon = F @ p["X"][:, T_old:]
-                return jnp.mean((recon - yn_new) ** 2)
-            loss, grads = jax.value_and_grad(loss_of)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+        def run(params, opt_state):
+            def body(carry, _):
+                params, opt_state = carry
+                def loss_of(p):
+                    recon = F @ p["X"][:, T_old:]
+                    if mask is None:
+                        return jnp.mean((recon - yn_new) ** 2)
+                    return (jnp.sum((recon - yn_new) ** 2 * mask[:, None])
+                            / (jnp.sum(mask) * T_new))
+                loss, grads = jax.value_and_grad(loss_of)(params)
+                updates, opt_state2 = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state2), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=epochs)
+            return params, opt_state, losses[-1]
 
-        loss = None
-        for _ in range(epochs):
-            params, opt_state, loss = step(params, opt_state)
+        params, opt_state, loss = run(params, opt_state)
         self.X = params["X"]
         return {"train_loss": float(loss)}
 
@@ -201,7 +263,9 @@ class TCMF:
             raise RuntimeError("fit first")
         x_future = self._roll(horizon)
         yn = self.F @ x_future
-        return np.asarray(yn) * self.y_scale + self.y_mean
+        # drop mesh-divisibility padding rows before un-normalizing
+        yn = np.asarray(yn)[:getattr(self, "_n", self.F.shape[0])]
+        return yn * self.y_scale + self.y_mean
 
     def evaluate(self, y_true: np.ndarray, metrics=("mae",)) -> list:
         pred = self.predict(np.asarray(y_true).shape[1])
@@ -238,11 +302,18 @@ class TCMFForecaster:
                           lr=max(learning_rate, 1e-3))
 
     def fit(self, x, val_len: int = 24, incremental: bool = False,
-            num_workers: Optional[int] = None, epochs: int = 100, **_):
+            num_workers: Optional[int] = None, epochs: int = 100,
+            mesh=None, **_):
+        """``num_workers > 1`` (the reference's distributed-TCMF knob) shards
+        the factorization over the current orca context's mesh; passing
+        ``mesh`` explicitly does the same."""
         y = x["y"] if isinstance(x, dict) else x
         if incremental and self.model.F is not None:
             return self.model.fit_incremental(y, epochs=epochs)
-        return self.model.fit(y, epochs=epochs, val_len=val_len)
+        if mesh is None and num_workers and num_workers > 1:
+            from ...common.context import get_context
+            mesh = get_context().mesh
+        return self.model.fit(y, epochs=epochs, val_len=val_len, mesh=mesh)
 
     def fit_incremental(self, x_incr, **kwargs):
         y = x_incr["y"] if isinstance(x_incr, dict) else x_incr
@@ -265,7 +336,8 @@ class TCMFForecaster:
                 "rank": m.rank, "window": m.window,
                 "channels": tuple(m.net.channels),
                 "kernel_size": m.net.kernel_size, "lr": m.lr,
-                "F": np.asarray(m.F), "X": np.asarray(m.X),
+                "F": np.asarray(m.F)[:getattr(m, "_n", m.F.shape[0])],
+                "X": np.asarray(m.X),
                 "net": jax.device_get(m.net_params),
                 "mean": m.y_mean, "scale": m.y_scale,
             }, f)

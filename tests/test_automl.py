@@ -37,6 +37,7 @@ def _make_data(n=256, seed=0):
     return {"x": x, "y": y}
 
 
+@pytest.mark.slow
 def test_auto_estimator_search(orca_context):
     import flax.linen as nn
 

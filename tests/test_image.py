@@ -110,6 +110,7 @@ def test_hflip_deterministic():
     np.testing.assert_array_equal(flipped[:, ::-1], img)
 
 
+@pytest.mark.slow
 def test_resnet_training_tiny(orca_context, image_dir):
     from analytics_zoo_tpu.feature.image import ImageResize
     from analytics_zoo_tpu.models.image import ResNet18

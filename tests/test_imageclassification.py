@@ -6,6 +6,8 @@ import pytest
 from analytics_zoo_tpu.models.image.imageclassification import (
     ImageClassifier, InceptionV1, LabelOutput)
 
+pytestmark = pytest.mark.slow  # full Inception-family forward/train/save-load cycles
+
 
 def _toy_images(n=16, size=32, classes=3, seed=0):
     """Images whose mean brightness encodes the class — learnable fast."""

@@ -96,9 +96,11 @@ def test_redis_broker_two_connections_compete(mini_redis):
     b2.close()
 
 
-def test_stream_trimmed_after_claim(mini_redis):
-    """Processed entries are XDELed so the stream (and mini-server memory)
-    stays bounded and XLEN means backlog, like the other brokers."""
+def test_stream_trimmed_after_result(mini_redis):
+    """Entries are XACKed/XDELed only once their result is published
+    (at-least-once: a worker that dies between claim and put_result leaves
+    its claims in the PEL for XAUTOCLAIM). After all results are in, the
+    stream (and mini-server memory) is compacted to zero."""
     broker = RedisBroker(mini_redis.host, mini_redis.port, stream="trim")
     for i in range(50):
         broker.enqueue(f"i{i}", b"x" * 100)
@@ -110,9 +112,13 @@ def test_stream_trimmed_after_claim(mini_redis):
             break
         got.extend(batch)
     assert len(got) == 50
+    # claimed but unacknowledged: entries survive until results publish
+    state = mini_redis._srv.state
+    assert len(state.streams[b"trim"].entries) == 50
+    for item_id, _ in got:
+        broker.put_result(item_id, b"done")
     assert broker.pending() == 0
     # server-side entry list actually compacted, not just tombstoned
-    state = mini_redis._srv.state
     assert len(state.streams[b"trim"].entries) == 0
     broker.close()
 
@@ -240,3 +246,30 @@ def test_cluster_serving_over_redis(mini_redis, orca_context):
     finally:
         serving.stop()
         engine_broker.close()
+
+
+def test_crash_after_claim_is_recovered(mini_redis):
+    """ADVICE r2: ack/delete must happen only after put_result, so a worker
+    that dies after claim_batch (previously: silent loss) leaves its entries
+    in the PEL where another consumer's XAUTOCLAIM recovers them."""
+    a = RedisBroker(mini_redis.host, mini_redis.port, stream="alo",
+                    claim_idle_ms=300)
+    for i in range(4):
+        a.enqueue(f"i{i}", b"payload")
+    assert len(a.claim_batch(4, timeout_s=0.2)) == 4
+    a.close()   # no put_result — simulated crash after claim
+
+    time.sleep(0.5)  # exceed claim_idle_ms
+    b = RedisBroker(mini_redis.host, mini_redis.port, stream="alo",
+                    claim_idle_ms=300)
+    recovered = []
+    for _ in range(10):
+        recovered += b.claim_batch(4, timeout_s=0.05)
+        if len(recovered) >= 4:
+            break
+        time.sleep(0.2)
+    assert len(recovered) == 4, f"recovered {len(recovered)}/4"
+    for item_id, _ in recovered:
+        b.put_result(item_id, b"done")
+    assert b.pending() == 0
+    b.close()

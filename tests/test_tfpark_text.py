@@ -21,6 +21,7 @@ def _token_batch(n=32, s=16, vocab=100, seed=0):
     return rng.randint(1, vocab, (n, s)).astype(np.int32)
 
 
+@pytest.mark.slow
 def test_bert_classifier_fit_predict(orca_context):
     ids = _token_batch()
     labels = (ids[:, 0] % 3).astype(np.int32)
