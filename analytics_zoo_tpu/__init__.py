@@ -8,6 +8,21 @@ serving runs compiled executables; AutoML trials schedule onto chip subsets.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor the standard JAX env contract even when a site hook has already
+    # imported jax and programmatically overridden jax_platforms (some TPU
+    # images prepend their platform plugin at interpreter start, which makes
+    # `JAX_PLATFORMS=cpu python ...` silently ignore the env). No-op when
+    # the env var is unset or backends are already initialized.
+    try:
+        import jax as _jax
+        if _jax.config.jax_platforms != _os.environ["JAX_PLATFORMS"]:
+            _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # noqa: BLE001 — never block import on config
+        pass
+
 from .common.config import OrcaConfig, OrcaContext
 from .common.context import (ClusterContext, get_context, init_orca_context,
                              stop_orca_context)

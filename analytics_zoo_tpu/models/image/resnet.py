@@ -20,6 +20,7 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -71,6 +72,37 @@ class BasicBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class SpaceToDepthStem(nn.Module):
+    """The ResNet stem conv (7x7/2 over 3 channels) rewritten as a 4x4/1
+    conv over the 2x2 space-to-depth input — mathematically identical (the
+    7x7 kernel is zero-padded to 8x8 and re-blocked, so offsets/padding line
+    up exactly), but the MXU sees 12 input channels instead of 3, which
+    starves it far less (the MLPerf-era TPU trick). The parameter keeps the
+    original (7,7,3,F) shape and the name ``conv_init`` kernel layout, so
+    checkpoints are interchangeable with the plain stem."""
+    features: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (7, 7, c, self.features), jnp.float32)
+        # scatter 7x7 into 8x8 with one leading zero row/col: kernel rows
+        # 0..7 then correspond to original offsets -4..+3, making every
+        # 2-row block land on one space-to-depth row
+        k8 = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k8 = k8.reshape(4, 2, 4, 2, c, self.features)
+        k8 = k8.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                    self.features)
+        xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        return jax.lax.conv_general_dilated(
+            xs.astype(self.dtype), k8.astype(self.dtype),
+            window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -78,6 +110,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     compute_dtype: jnp.dtype = jnp.bfloat16
     return_logits: bool = True
+    stem: str = "conv7"    # "conv7" | "s2d" (space-to-depth, same math)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -100,8 +133,13 @@ class ResNet(nn.Module):
                                   self.compute_dtype)
             x = (x.astype(self.compute_dtype) - mean) * inv_std
         x = x.astype(self.compute_dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 use_bias=False, name="conv_init")(x)
+        if self.stem == "s2d" and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            x = SpaceToDepthStem(self.num_filters, dtype=self.compute_dtype,
+                                 name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)],
+                     use_bias=False, name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -155,11 +155,33 @@ class InferenceModel:
                                  if k != "params"}}}, f)
 
     def load_tf(self, model_path: str, backend: str = "convert",
-                **_) -> "InferenceModel":
-        """Load a TF SavedModel / .h5 keras model (reference load_tf,
-        inference_model.py:70). The graph is converted to flax and compiled
-        for TPU when possible; otherwise falls back to jax2tf.call_tf."""
+                input_names=None, output_names=None, **_
+                ) -> "InferenceModel":
+        """Load a TF SavedModel / .h5 keras model, a frozen ``.pb`` graphdef
+        (with ``input_names``/``output_names``), or an ``export_tf`` folder
+        (reference load_tf variants, inference_model.py:70 +
+        TFNet.scala:56). Keras models are converted to flax and compiled for
+        TPU when possible; frozen graphs execute via the TFNet path."""
+        import os
         import tensorflow as tf
+        frozen_in_dir = (os.path.isdir(model_path) and os.path.exists(
+            os.path.join(model_path, "frozen_inference_graph.pb")))
+        if model_path.endswith(".pb") or frozen_in_dir:
+            from ...tfpark import TFNet
+            if frozen_in_dir:
+                net = TFNet.from_export_folder(model_path)
+            else:
+                if not (input_names and output_names):
+                    raise ValueError(
+                        "frozen .pb needs input_names and output_names "
+                        "(tensor names like 'input:0')")
+                net = TFNet.from_frozen_graph(model_path, input_names,
+                                              output_names)
+            donor = net.as_inference_model()
+            self._apply_fn = donor._apply_fn
+            self._variables = donor._variables
+            self._cache.clear()
+            return self
         model = tf.keras.models.load_model(model_path)
         try:
             from ...orca.learn.tf2.keras_bridge import build_flax_from_keras

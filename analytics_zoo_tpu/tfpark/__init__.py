@@ -179,13 +179,102 @@ class TFOptimizer:
 
 
 class TFNet:
-    @classmethod
-    def from_export_folder(cls, *args, **kwargs):
-        raise NotImplementedError(
-            "TF graph inference runs through "
-            "pipeline.inference.InferenceModel (load_tf) on TPU")
+    """Frozen-graph inference net (reference: TFNet.scala:56 executes the
+    frozen graph through TF Java; python wrapper tfnet.py:180
+    ``from_export_folder`` over util/tf.py ``export_tf`` folders).
 
-    from_session = from_export_folder
+    The graphdef is imported once and pruned to a concrete
+    inputs->outputs function. ``predict`` executes it with TF's runtime on
+    the host; ``as_inference_model()`` wraps it for the serving stack via
+    ``jax2tf.call_tf`` — note call_tf executes TF kernels host-side, so on a
+    TPU-only deployment prefer re-exporting the model and ``load_tf`` (the
+    keras->flax conversion) for a native XLA path."""
+
+    def __init__(self, fn, input_names, output_names):
+        self._fn = fn
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+
+    @classmethod
+    def from_frozen_graph(cls, pb_path: str, input_names, output_names
+                          ) -> "TFNet":
+        """Load a frozen GraphDef ``.pb`` plus explicit tensor names
+        (e.g. ``["input:0"]`` / ``["logits:0"]``)."""
+        import tensorflow as tf
+        gd = tf.compat.v1.GraphDef()
+        with open(pb_path, "rb") as f:
+            gd.ParseFromString(f.read())
+
+        def _import():
+            tf.compat.v1.import_graph_def(gd, name="")
+
+        wrapped = tf.compat.v1.wrap_function(_import, [])
+        fn = wrapped.prune(
+            feeds=[wrapped.graph.as_graph_element(n) for n in input_names],
+            fetches=[wrapped.graph.as_graph_element(n) for n in output_names])
+        return cls(fn, input_names, output_names)
+
+    @classmethod
+    def from_export_folder(cls, folder: str) -> "TFNet":
+        """Load an ``export_tf`` folder: ``frozen_inference_graph.pb`` +
+        ``graph_meta.json`` with input/output tensor names (reference layout:
+        pyzoo/zoo/util/tf.py:184-198)."""
+        import json as _json
+        import os
+        if not os.path.isdir(folder):
+            raise ValueError(f"{folder} does not exist")
+        with open(os.path.join(folder, "graph_meta.json")) as f:
+            meta = _json.load(f)
+        return cls.from_frozen_graph(
+            os.path.join(folder, "frozen_inference_graph.pb"),
+            meta["input_names"], meta["output_names"])
+
+    @classmethod
+    def from_session(cls, sess, inputs, outputs, **_) -> "TFNet":
+        """Freeze the session's graph on the given tensors (reference
+        tfnet.py:237 from_session -> export_tf -> TFNet)."""
+        import tensorflow as tf
+        from tensorflow.python.framework import graph_util  # noqa: WPS433
+        with sess.graph.as_default():
+            gd = tf.compat.v1.graph_util.convert_variables_to_constants(
+                sess, sess.graph_def, [t.op.name for t in outputs])
+        import tempfile, os  # noqa: E401
+        tmp = tempfile.mkdtemp(prefix="zoo_tfnet_")
+        pb = os.path.join(tmp, "frozen_inference_graph.pb")
+        with open(pb, "wb") as f:
+            f.write(gd.SerializeToString())
+        return cls.from_frozen_graph(pb, [t.name for t in inputs],
+                                     [t.name for t in outputs])
+
+    def predict(self, x, batch_size: int = 0, distributed: bool = False):
+        import numpy as _np
+        import tensorflow as tf
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        outs = self._fn(*[tf.convert_to_tensor(_np.asarray(a)) for a in xs])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        outs = [_np.asarray(o) for o in outs]
+        return outs if len(outs) > 1 else outs[0]
+
+    def as_inference_model(self):
+        """Wrap for ClusterServing / InferenceModel.predict (host-side TF
+        execution via call_tf; see class docstring for the TPU caveat)."""
+        from ..pipeline.inference import InferenceModel
+        from jax.experimental import jax2tf
+        fn = self._fn
+
+        def apply_fn(variables, *x):
+            out = jax2tf.call_tf(fn)(*x)
+            # pruned concrete functions return a list of fetches; a single
+            # output unwraps so predict() returns the array itself
+            if isinstance(out, (list, tuple)) and len(out) == 1:
+                return out[0]
+            return out
+
+        im = InferenceModel()
+        im._apply_fn = apply_fn
+        im._variables = {}
+        return im
 
 
 def ZooOptimizer(optimizer, grad_accum_steps: int = 1):

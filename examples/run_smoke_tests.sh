@@ -7,14 +7,25 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+# examples import the package the way a pip-install user would; running from
+# the repo checkout needs the repo root on the path
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
 for script in \
     examples/orca/learn/ncf_movielens.py \
     examples/orca/learn/resnet50_imagenet.py \
+    examples/orca/learn/wide_and_deep_recommendation.py \
+    examples/orca/learn/bert_pretrain_tp_sp.py \
+    examples/orca/multihost_walkthrough.py \
     examples/nnframes/fraud_detection_mlp.py \
     examples/zouwu/autots_forecast.py \
     examples/tfpark/bert_intent_classification.py \
-    examples/serving/object_detection_serving.py; do
+    examples/serving/object_detection_serving.py \
+    examples/streaming/streaming_object_detection.py \
+    examples/textclassification/news_text_classification.py \
+    examples/anomalydetection/anomaly_detection_time_series.py \
+    examples/vision/image_augmentation.py \
+    examples/automl/auto_xgboost_fit.py; do
   echo "=== $script --smoke"
   python "$script" --smoke
 done
