@@ -11,15 +11,18 @@ __version__ = "0.1.0"
 import os as _os
 
 if _os.environ.get("JAX_PLATFORMS"):
-    # Honor the standard JAX env contract even when a site hook has already
-    # imported jax and programmatically overridden jax_platforms (some TPU
-    # images prepend their platform plugin at interpreter start, which makes
-    # `JAX_PLATFORMS=cpu python ...` silently ignore the env). No-op when
-    # the env var is unset or backends are already initialized.
+    # Honor the standard JAX env contract when a site hook has programmatically
+    # replaced jax_platforms with its own multi-platform list (some TPU images
+    # prepend their platform plugin at interpreter start, which makes
+    # `JAX_PLATFORMS=cpu python ...` silently ignore the env). Only the
+    # hook's comma-list is overridden: a single-platform value means user
+    # code (e.g. a test conftest) chose it explicitly and must win.
     try:
         import jax as _jax
-        if _jax.config.jax_platforms != _os.environ["JAX_PLATFORMS"]:
-            _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+        _cfg = _jax.config.jax_platforms
+        _env = _os.environ["JAX_PLATFORMS"]
+        if _cfg and "," in _cfg and _cfg != _env:
+            _jax.config.update("jax_platforms", _env)
     except Exception:  # noqa: BLE001 — never block import on config
         pass
 

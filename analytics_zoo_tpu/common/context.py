@@ -140,7 +140,11 @@ def init_orca_context(cluster_mode: str = "local",
             logger.info("jax.distributed initialized: process %d/%d",
                         jax.process_index(), jax.process_count())
         elif cluster_mode == "cpu-sim":
-            jax.config.update("jax_platforms", "cpu")
+            # no-op when already cpu: config updates after backend
+            # initialization are unreliable (silently ignored on this jax
+            # build), so an idempotent guard keeps behavior predictable
+            if jax.config.jax_platforms != "cpu":
+                jax.config.update("jax_platforms", "cpu")
 
         mesh = create_mesh(cfg.mesh_axes)
         ctx = ClusterContext(cfg, mesh)

@@ -246,21 +246,110 @@ def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     out = _flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v)
+    return out, (q, k, v, out)
+
+
+def _lse_pass(qf, kf, causal, sm_scale, block_k, q_pos):
+    """Recompute the forward logsumexp (b, h, s_q) with an online scan over
+    K blocks — carries only (m, l), never an output accumulator. One of the
+    two forward matmuls; cheaper than saving L through the Pallas kernel
+    (a lane-padded L output would cost s_q x 128 f32 per head in HBM)."""
+    b, s_q, h, d = qf.shape
+    s_k = kf.shape[1]
+    nk = s_k // block_k
+    k_blocks = jnp.moveaxis(kf.reshape(b, nk, block_k, h, d), 1, 0)
+    starts = jnp.arange(nk) * block_k
+
+    def step(carry, inputs):
+        m, l = carry
+        k_blk, k0 = inputs
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            k_pos = k0 + jnp.arange(block_k)
+            s = jnp.where(q_pos[None, None, :, None] >=
+                          k_pos[None, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(s - m_new[..., None]), axis=-1)
+        return (m_new, l), None
+
+    init = (jnp.full((b, h, s_q), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s_q), jnp.float32))
+    (m, l), _ = lax.scan(step, init, (k_blocks, starts))
+    return m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    # Blockwise-recompute backward: differentiate the scan-over-K-blocks
-    # form (jax.checkpoint per block) — score tiles recompute one
-    # (Sq, block_k) at a time, so the S x S matrix never rematerializes
-    # (scan carries still cost O(Sq*D) per block; see blockwise_attention's
-    # memory note).
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale, block_k=block_k),
-        q, k, v)
-    return vjp(g)
+    """FlashAttention-2-style tiled backward in pure JAX: recompute the
+    logsumexp, then one (q-block x k-block) double scan that rebuilds each
+    P tile from (q, k, L) and accumulates dq/dk/dv — peak residual memory
+    is O(S*D) carries plus one (block_q, block_k) tile per (b, h), i.e.
+    truly linear in S (the round-2 backward still carried an (Sq, D)
+    accumulator per K block through the differentiated scan)."""
+    q, k, v, o = res
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    bq, bk = block_q, block_k
+    nq, nk = s_q // bq, s_k // bk
+    f32 = jnp.float32
+    qf, kf, vf, gf, of = (a.astype(f32) for a in (q, k, v, g, o))
+    q_pos = jnp.arange(s_q) + (s_k - s_q)     # bottom-right aligned causal
+
+    L = _lse_pass(qf, kf, causal, sm_scale, bk, q_pos)     # (b, h, s_q)
+    Dvec = jnp.sum(gf * of, axis=-1)                       # (b, s_q, h)
+    Dvec = jnp.moveaxis(Dvec, -1, 1)                       # (b, h, s_q)
+
+    def qsplit(a):      # (b, s_q, ...) -> (nq, b, bq, ...)
+        return jnp.moveaxis(a.reshape(b, nq, bq, *a.shape[2:]), 1, 0)
+
+    def ksplit(a):
+        return jnp.moveaxis(a.reshape(b, nk, bk, *a.shape[2:]), 1, 0)
+
+    q_blocks, g_blocks = qsplit(qf), qsplit(gf)            # (nq,b,bq,h,d)
+    L_blocks = jnp.moveaxis(L.reshape(b, h, nq, bq), 2, 0)  # (nq,b,h,bq)
+    D_blocks = jnp.moveaxis(Dvec.reshape(b, h, nq, bq), 2, 0)
+    k_blocks, v_blocks = ksplit(kf), ksplit(vf)            # (nk,b,bk,h,d)
+
+    def outer(carry, qin):
+        dk_acc, dv_acc = carry                             # (nk,b,bk,h,d)
+        q_blk, g_blk, L_blk, D_blk, qi = qin
+
+        def inner(dq_blk, kin):
+            k_blk, v_blk, ki = kin
+            s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk,
+                           preferred_element_type=f32) * sm_scale
+            if causal:
+                qp = (s_k - s_q) + qi * bq + jnp.arange(bq)
+                kp = ki * bk + jnp.arange(bk)
+                s = jnp.where(qp[None, None, :, None] >=
+                              kp[None, None, None, :], s, NEG_INF)
+            p = jnp.exp(s - L_blk[..., None])              # (b,h,bq,bk)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", g_blk, v_blk,
+                            preferred_element_type=f32)
+            ds = p * (dp - D_blk[..., None]) * sm_scale
+            dq_blk = dq_blk + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk,
+                                         preferred_element_type=f32)
+            dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, q_blk,
+                              preferred_element_type=f32)
+            dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, g_blk,
+                              preferred_element_type=f32)
+            return dq_blk, (dk_c, dv_c)
+
+        dq_blk, (dk_cs, dv_cs) = lax.scan(
+            inner, jnp.zeros((b, bq, h, d), f32),
+            (k_blocks, v_blocks, jnp.arange(nk)))
+        return (dk_acc + dk_cs, dv_acc + dv_cs), dq_blk
+
+    zeros_kv = jnp.zeros((nk, b, bk, h, d), f32)
+    (dk_s, dv_s), dq_s = lax.scan(
+        outer, (zeros_kv, zeros_kv),
+        (q_blocks, g_blocks, L_blocks, D_blocks, jnp.arange(nq)))
+
+    dq = jnp.moveaxis(dq_s, 0, 1).reshape(b, s_q, h, d).astype(q.dtype)
+    dk = jnp.moveaxis(dk_s, 0, 1).reshape(b, s_k, h, d).astype(k.dtype)
+    dv = jnp.moveaxis(dv_s, 0, 1).reshape(b, s_k, h, d).astype(v.dtype)
+    return dq, dk, dv
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -12,9 +12,12 @@ Layout note: unlike the Sequential fast path (which transposes to NHWC),
 the fx interpreter keeps **torch's native NCHW** end-to-end — convolutions
 run through ``lax.conv_general_dilated`` with ``('NCHW','OIHW','NCHW')``
 dimension numbers and weights import with zero permutation. XLA:TPU lays
-out conv operands internally, so this is correctness-first with near-par
-performance; models written natively in flax (models/image/resnet.py) remain
-the peak-perf path.
+out conv operands internally, so this costs ~3% vs the native-NHWC flax
+twin — MEASURED round 3 on a v5e chip: interleaved A/B of an fx-converted
+torchvision-style ResNet-18 vs models/image/resnet.py at f32/batch 64 gave
+fx/native step-time ratios 1.028 (NCHW) and 1.025 (per-conv NHWC routing —
+i.e. a layout pass would buy nothing; XLA already assigns layouts). Models
+written natively in flax remain the peak-perf path mainly via bf16.
 
 Unsupported ops raise ``TorchConversionError`` naming the exact node and
 op so users know what to port.

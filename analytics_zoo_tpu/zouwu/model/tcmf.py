@@ -52,12 +52,38 @@ class _XSeqModel(nn.Module):
         return nn.Dense(self.rank, name="head")(h[:, -1])
 
 
+class _LocalYModel(nn.Module):
+    """DeepGLO's per-series hybrid (reference model/tcmf/DeepGLO.py:904):
+    one weight-shared TCN consumes each series' own recent history alongside
+    the global factorization's reconstruction for the same steps (plus
+    optional seasonal-phase covariates), and emits a RESIDUAL correction to
+    the global forecast — the global model supplies cross-series structure,
+    the local model corrects per-series idiosyncrasy, and a zero-output
+    local net degrades gracefully to the global forecast. Input
+    (batch, w, C): channels = [y_history, global_recon(, sin, cos)]."""
+    channels: Tuple[int, ...] = (16, 16)
+    kernel_size: int = 3
+
+    @nn.compact
+    def __call__(self, yw):
+        h = _TemporalConvNet(self.channels, self.kernel_size)(yw)
+        # zero-init head: training starts exactly at the global forecast
+        return nn.Dense(1, name="head",
+                        kernel_init=nn.initializers.zeros)(h[:, -1])[..., 0]
+
+
 class TCMF:
-    """Core model: fit(Y) learns F, X, TCN; predict(horizon) rolls forward."""
+    """Core model: fit(Y) learns F, X, TCN (+ optional per-series local
+    hybrid); predict(horizon) rolls forward."""
 
     def __init__(self, rank: int = 16, tcn_channels: Tuple[int, ...] = (32, 32),
                  kernel_size: int = 3, window: int = 16, lam: float = 1.0,
-                 lr: float = 1e-2, seed: int = 0, rollout_steps: int = 8):
+                 lr: float = 1e-2, seed: int = 0, rollout_steps: int = 8,
+                 local_model="auto", local_window: int = 14,
+                 local_channels: Tuple[int, ...] = (16, 16),
+                 local_kernel_size: int = 3,
+                 seasonal_period: Optional[int] = None,
+                 local_min_windows: int = 20_000):
         self.rank = rank
         self.window = window
         self.lam = lam
@@ -66,6 +92,25 @@ class TCMF:
         self.rollout_steps = rollout_steps
         self.net = _XSeqModel(rank=rank, channels=tuple(tcn_channels),
                               kernel_size=kernel_size)
+        # "auto": the DeepGLO hybrid engages only when the corpus offers
+        # enough (series x window) samples to fit the shared local TCN
+        # without memorizing reconstruction noise — measured on a small
+        # panel (48 x 76) every local-model variant LOST to the global
+        # forecast out-of-sample while driving its own train loss to ~0.01
+        # (docs/performance_notes.md); DeepGLO's published wins are at
+        # T ~ 10k+ (traffic/electricity).
+        self.local_model = local_model
+        self.local_min_windows = local_min_windows
+        self.local_window = local_window
+        # time covariates for the local hybrid (reference TCMF's
+        # ``use_time`` temporal covariates, tcmf_forecaster.py): the
+        # seasonal phase is fully known at forecast time, so the local net
+        # can model periodic structure instead of free-running past it
+        self.seasonal_period = seasonal_period
+        self.ynet = _LocalYModel(channels=tuple(local_channels),
+                                 kernel_size=int(local_kernel_size)) \
+            if local_model else None
+        self.ynet_params = None
         self.F = None
         self.X = None
         self.net_params = None
@@ -196,7 +241,147 @@ class TCMF:
         self.F = params["F"]
         self.X = params["X"]
         self.net_params = params["net"]
-        return {"train_loss": float(loss)}
+        out = {"train_loss": float(loss)}
+        if self._local_enabled(n, T):
+            out["local_loss"] = self._fit_local(yn, mask, epochs)
+        else:
+            self.ynet_params = None
+        return out
+
+    def _local_enabled(self, n: int, T: int) -> bool:
+        if not self.local_model:
+            return False
+        if self.local_model == "auto":
+            return (n * max(T - self.local_window, 0)
+                    >= self.local_min_windows)
+        return True
+
+    def _fit_local(self, yn, mask, epochs: int) -> float:
+        """Train the DeepGLO-style per-series hybrid: a weight-shared TCN on
+        [own history, global reconstruction] windows (reference
+        DeepGLO.py:904 trains Ynet against the factorized output the same
+        way). Runs as one jitted lax.scan like the global phase."""
+        w = self.local_window
+        n_pad, T = yn.shape
+        if T <= w + 1:
+            return float("nan")
+        self._T_fit = T
+        recon = self.F @ self.X                             # (n_pad, T)
+        # bound the materialized window set: the windowed training tensors
+        # are O(len(starts) * n * w); stride the starts so large panels
+        # (DeepGLO's n~1000s, T~10k regime) stay within a fixed budget
+        # instead of OOMing exactly where the auto-gate enables the hybrid
+        max_windows = 200_000
+        stride = max(1, (T - w) * n_pad // max_windows)
+        starts = jnp.arange(0, T - w, stride)
+        cov = self._time_cov(jnp.arange(T))                 # (T, 2) | None
+        n_ch = 2 if cov is None else 4
+
+        def windows_of(mat):
+            sl = jax.vmap(lambda s: jax.lax.dynamic_slice(
+                mat, (0, s), (n_pad, w)))(starts)           # (S, n, w)
+            return sl
+
+        ywin = windows_of(yn)
+        rwin = windows_of(recon)
+        inp = jnp.stack([ywin, rwin], axis=-1)              # (S, n, w, 2)
+        if cov is not None:
+            covwin = jax.vmap(lambda s: jax.lax.dynamic_slice(
+                cov, (s, 0), (w, 2)))(starts)               # (S, w, 2)
+            inp = jnp.concatenate(
+                [inp, jnp.broadcast_to(covwin[:, None],
+                                       (len(starts), n_pad, w, 2))], -1)
+        # residual target: what the global reconstruction got wrong
+        tgt = (yn[:, starts + w] - recon[:, starts + w]).T  # (S, n)
+        flat_in = inp.reshape(-1, w, n_ch)
+        flat_tgt = tgt.reshape(-1)
+        if mask is not None:
+            wts = jnp.tile(mask[None, :], (len(starts), 1)).reshape(-1)
+        else:
+            wts = jnp.ones_like(flat_tgt)
+
+        rng = jax.random.PRNGKey(self.seed + 7)
+        params = self.ynet.init({"params": rng},
+                                jnp.zeros((1, w, n_ch)))["params"]
+        tx = optax.adam(self.lr)
+        opt_state = jax.jit(tx.init)(params)
+
+        # closed-loop rollout material: free-running the y channel is what
+        # predict() does, so train that property too (same cure as the
+        # global model's rollout term — one-step training alone compounds)
+        h = min(self.rollout_steps, max(1, (T - w) // 4))
+        roll_starts = jnp.arange(0, T - w - h,
+                                 max(1, (T - w - h) // 16))
+
+        def slices_at(mat, length):
+            return jax.vmap(lambda s: jax.lax.dynamic_slice(
+                mat, (0, s), (n_pad, length)))(roll_starts)
+
+        roll_y0 = slices_at(yn, w)                          # (S, n, w)
+        roll_r = slices_at(recon, w + h)                    # (S, n, w+h)
+        roll_tgt = slices_at(yn, w + h)[:, :, w:]           # (S, n, h)
+        roll_cov = None
+        if cov is not None:
+            roll_cov = jax.vmap(lambda s: jax.lax.dynamic_slice(
+                cov, (s, 0), (w + h, 2)))(roll_starts)      # (S, w+h, 2)
+
+        @jax.jit
+        def run(params, opt_state):
+            def body(carry, _):
+                params, opt_state = carry
+                def loss_of(p):
+                    pred = self.ynet.apply({"params": p}, flat_in)
+                    one_step = (jnp.sum((pred - flat_tgt) ** 2 * wts)
+                                / jnp.maximum(jnp.sum(wts), 1.0))
+
+                    def roll(ybuf, k):
+                        # iteration k: ybuf covers positions k..k+w-1,
+                        # predicting position k+w (recon channel aligned)
+                        rbuf = jax.lax.dynamic_slice(
+                            roll_r, (0, 0, k), roll_y0.shape)
+                        inp = jnp.stack([ybuf, rbuf], -1)   # (S, n, w, 2)
+                        if roll_cov is not None:
+                            cwin = jax.lax.dynamic_slice(
+                                roll_cov, (0, k, 0),
+                                (roll_cov.shape[0], w, 2))  # (S, w, 2)
+                            inp = jnp.concatenate(
+                                [inp, jnp.broadcast_to(
+                                    cwin[:, None],
+                                    inp.shape[:3] + (2,))], -1)
+                        resid = self.ynet.apply(
+                            {"params": p}, inp.reshape(-1, w, n_ch)
+                        ).reshape(ybuf.shape[0], n_pad)
+                        r_next = jax.lax.dynamic_slice(
+                            roll_r, (0, 0, k + w),
+                            roll_y0.shape[:2] + (1,))[..., 0]
+                        yk = r_next + resid                 # residual form
+                        ybuf = jnp.concatenate(
+                            [ybuf[:, :, 1:], yk[:, :, None]], axis=2)
+                        return ybuf, yk
+
+                    _, rolled = jax.lax.scan(roll, roll_y0, jnp.arange(h))
+                    rolled = jnp.moveaxis(rolled, 0, -1)    # (S, n, h)
+                    if mask is None:
+                        closed = jnp.mean(
+                            (rolled - jax.lax.stop_gradient(roll_tgt)) ** 2)
+                    else:
+                        closed = (jnp.sum(
+                            (rolled - jax.lax.stop_gradient(roll_tgt)) ** 2
+                            * mask[None, :, None])
+                            / jnp.maximum(jnp.sum(mask) * rolled.shape[0]
+                                          * h, 1.0))
+                    return one_step + closed
+                loss, grads = jax.value_and_grad(loss_of)(params)
+                updates, opt2 = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt2), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=epochs)
+            return params, losses[-1]
+
+        self.ynet_params, loss = run(params, opt_state)
+        self._yn_tail = yn[:, -w:]          # history buffer for predict()
+        self._recon_tail = recon[:, -w:]
+        return float(loss)
 
     def fit_incremental(self, y_new: np.ndarray, epochs: int = 30):
         """Extend X for the new columns, keep F/TCN warm (reference
@@ -243,6 +428,13 @@ class TCMF:
 
         params, opt_state, loss = run(params, opt_state)
         self.X = params["X"]
+        if self.local_model and self.ynet_params is not None:
+            w = self.local_window
+            self._yn_tail = jnp.concatenate(
+                [self._yn_tail, yn_new], axis=1)[:, -w:]
+            self._recon_tail = (self.F @ self.X)[:, -w:]
+            # keep the seasonal-phase clock in sync with the extended series
+            self._T_fit = getattr(self, "_T_fit", T_old) + T_new
         return {"train_loss": float(loss)}
 
     def _roll(self, horizon: int) -> jnp.ndarray:
@@ -262,10 +454,48 @@ class TCMF:
         if self.F is None:
             raise RuntimeError("fit first")
         x_future = self._roll(horizon)
-        yn = self.F @ x_future
+        yn = self.F @ x_future                              # global forecast
+        if self.local_model and self.ynet_params is not None:
+            yn = self._predict_hybrid(yn, horizon)
         # drop mesh-divisibility padding rows before un-normalizing
         yn = np.asarray(yn)[:getattr(self, "_n", self.F.shape[0])]
         return yn * self.y_scale + self.y_mean
+
+    def _time_cov(self, t):
+        """Seasonal phase covariates [sin, cos] for time indices ``t``
+        (the reference's use_time temporal covariates)."""
+        if not self.seasonal_period:
+            return None
+        ang = 2 * jnp.pi * t / self.seasonal_period
+        return jnp.stack([jnp.sin(ang), jnp.cos(ang)], -1)
+
+    def _predict_hybrid(self, recon_future, horizon: int):
+        """Roll the local hybrid forward: the y channel free-runs on its own
+        predictions, the recon channel is supplied by the global forecast,
+        and the seasonal-phase channels are exactly known for the future
+        (DeepGLO prediction combination)."""
+        w = self.local_window
+        T = getattr(self, "_T_fit", self._yn_tail.shape[1])
+        ybuf0 = self._yn_tail                               # (n, w)
+        rbuf0 = self._recon_tail
+        n = ybuf0.shape[0]
+
+        def step(carry, inputs):
+            ybuf, rbuf = carry
+            k, rk = inputs
+            inp = jnp.stack([ybuf, rbuf], axis=-1)          # (n, w, 2)
+            cov = self._time_cov((T - w) + k + jnp.arange(w))
+            if cov is not None:
+                inp = jnp.concatenate(
+                    [inp, jnp.broadcast_to(cov[None], (n, w, 2))], -1)
+            yk = rk + self.ynet.apply({"params": self.ynet_params}, inp)
+            ybuf = jnp.concatenate([ybuf[:, 1:], yk[:, None]], axis=1)
+            rbuf = jnp.concatenate([rbuf[:, 1:], rk[:, None]], axis=1)
+            return (ybuf, rbuf), yk
+
+        _, ys = jax.lax.scan(step, (ybuf0, rbuf0),
+                             (jnp.arange(horizon), recon_future.T))
+        return ys.T                                         # (n, horizon)
 
     def evaluate(self, y_true: np.ndarray, metrics=("mae",)) -> list:
         pred = self.predict(np.asarray(y_true).shape[1])
@@ -295,11 +525,20 @@ class TCMFForecaster:
                  kernel_size: int = 7, dropout: float = 0.1, rank: int = 64,
                  kernel_size_Y: int = 7, learning_rate: float = 0.0005,
                  normalize: bool = False, use_time: bool = True,
-                 svd: bool = True, **_):
+                 svd: bool = True, seasonal_period: Optional[int] = None,
+                 **_):
+        # num_channels_Y / kernel_size_Y configure the per-series local
+        # hybrid (the reference's Ynet, DeepGLO.py:904); use_time +
+        # seasonal_period feed it the reference's temporal covariates
         self.model = TCMF(rank=min(rank, 64),
                           tcn_channels=tuple(num_channels_X),
                           kernel_size=min(kernel_size, 5),
-                          lr=max(learning_rate, 1e-3))
+                          lr=max(learning_rate, 1e-3),
+                          local_model="auto",
+                          local_channels=tuple(num_channels_Y),
+                          local_kernel_size=min(int(kernel_size_Y), 5),
+                          seasonal_period=(seasonal_period
+                                           if use_time else None))
 
     def fit(self, x, val_len: int = 24, incremental: bool = False,
             num_workers: Optional[int] = None, epochs: int = 100,
@@ -331,29 +570,53 @@ class TCMFForecaster:
     def save(self, path: str):
         import pickle
         m = self.model
+        n = getattr(m, "_n", m.F.shape[0])
+        blob = {
+            "rank": m.rank, "window": m.window,
+            "channels": tuple(m.net.channels),
+            "kernel_size": m.net.kernel_size, "lr": m.lr,
+            "F": np.asarray(m.F)[:n],
+            "X": np.asarray(m.X),
+            "net": jax.device_get(m.net_params),
+            "mean": m.y_mean, "scale": m.y_scale,
+        }
+        if m.local_model and m.ynet_params is not None:
+            blob["local"] = {
+                "window": m.local_window,
+                "channels": tuple(m.ynet.channels),
+                "params": jax.device_get(m.ynet_params),
+                "yn_tail": np.asarray(m._yn_tail)[:n],
+                "recon_tail": np.asarray(m._recon_tail)[:n],
+                "T_fit": getattr(m, "_T_fit", None),
+                "seasonal_period": m.seasonal_period,
+            }
         with open(path, "wb") as f:
-            pickle.dump({
-                "rank": m.rank, "window": m.window,
-                "channels": tuple(m.net.channels),
-                "kernel_size": m.net.kernel_size, "lr": m.lr,
-                "F": np.asarray(m.F)[:getattr(m, "_n", m.F.shape[0])],
-                "X": np.asarray(m.X),
-                "net": jax.device_get(m.net_params),
-                "mean": m.y_mean, "scale": m.y_scale,
-            }, f)
+            pickle.dump(blob, f)
 
     @classmethod
     def load(cls, path: str) -> "TCMFForecaster":
         import pickle
         with open(path, "rb") as f:
             blob = pickle.load(f)
+        loc = blob.get("local")
         fc = cls.__new__(cls)
         fc.model = TCMF(rank=blob["rank"], tcn_channels=blob["channels"],
-                        kernel_size=blob["kernel_size"], lr=blob["lr"])
+                        kernel_size=blob["kernel_size"], lr=blob["lr"],
+                        local_model=loc is not None,
+                        local_window=loc["window"] if loc else 14,
+                        local_channels=tuple(loc["channels"]) if loc
+                        else (16, 16),
+                        seasonal_period=(loc or {}).get("seasonal_period"))
         m = fc.model
         m.window = blob["window"]
         m.F = jnp.asarray(blob["F"])
         m.X = jnp.asarray(blob["X"])
         m.net_params = blob["net"]
         m.y_mean, m.y_scale = blob["mean"], blob["scale"]
+        if loc is not None:
+            m.ynet_params = loc["params"]
+            m._yn_tail = jnp.asarray(loc["yn_tail"])
+            m._recon_tail = jnp.asarray(loc["recon_tail"])
+            if loc.get("T_fit") is not None:
+                m._T_fit = loc["T_fit"]
         return fc

@@ -106,8 +106,46 @@ def _compute_loop(engine, dev_batches, steps: int) -> float:
     return (time.perf_counter() - t0) / steps
 
 
+def _compute_loop_scanned(engine, dev_batch, steps: int) -> float:
+    """Pure chip rate: `steps` train steps inside ONE jitted lax.scan, so
+    per-step host dispatch (≈5 ms over the dev tunnel — measured, see
+    docs/performance_notes.md round-3 notes) is excluded. This is the
+    number that survives to a real TPU host, where dispatch overlaps; for
+    small models (NCF/MLP) the per-dispatch loop above measures the tunnel,
+    not the chip."""
+    import jax
+    import jax.numpy as jnp
+
+    step_fn = engine._train_step
+    x, y, w = dev_batch.x, dev_batch.y, dev_batch.w
+
+    @jax.jit
+    def multi(params, extra, opt_state):
+        def body(carry, i):
+            params, extra, opt_state = carry
+            params, extra, opt_state, loss = step_fn(
+                params, extra, opt_state, i, x, y, w)
+            return (params, extra, opt_state), loss
+        (params, extra, opt_state), losses = jax.lax.scan(
+            body, (params, extra, opt_state), jnp.arange(steps))
+        return params, extra, opt_state, losses[-1]
+
+    p, e, o = engine.params, engine.extra_vars, engine.opt_state
+    p, e, o, l = multi(p, e, o)
+    float(l)                                    # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p, e, o, l = multi(p, e, o)
+        float(l)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    engine.params, engine.extra_vars, engine.opt_state = p, e, o
+    return best
+
+
 def bench_resnet50(smoke: bool) -> dict:
     import jax
+    import jax.numpy as jnp
     from analytics_zoo_tpu.common.context import get_context
     from analytics_zoo_tpu.models.image.resnet import resnet
     from analytics_zoo_tpu.orca.data.image import (ImageNetPipeline,
@@ -179,6 +217,23 @@ def bench_resnet50(smoke: bool) -> dict:
         jax.device_put(probe).block_until_ready()
         hot_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
 
+        # 2b) demonstrated-ceiling probe: best sustained bf16 matmul rate on
+        # THIS device right now (8192^3, chained in-jit). The nominal spec
+        # peak is not attainable on shared/fractional dev chips, so MFU is
+        # reported against both (docs/performance_notes.md round-3 notes).
+        @jax.jit
+        def _mm_chain(a):
+            return jax.lax.fori_loop(0, 8, lambda i, acc: acc @ a, a)
+        mm = jax.device_put(jnp.ones((8192, 8192), jnp.bfloat16))
+        float(_mm_chain(mm)[0, 0].astype(jnp.float32))
+        t0 = time.perf_counter()
+        out = _mm_chain(mm)
+        float(out[0, 0].astype(jnp.float32))
+        # the probe runs on one device; scale to the whole mesh so the
+        # step-FLOPs numerator (all chips) divides a like-for-like ceiling
+        achievable = (2 * 8192**3 * 8 / (time.perf_counter() - t0)
+                      * max(jax.device_count(), 1))
+
         # 3) end-to-end: every step assembles a fresh host batch from the
         #    memory-mapped shards and feeds it straight into the jit
         t0 = time.perf_counter()
@@ -208,6 +263,9 @@ def bench_resnet50(smoke: bool) -> dict:
                 "compute_vs_baseline": round(comp / RESNET_BASELINE, 3),
                 "mfu_compute": (round(step_flops / dt_compute / peak_rate, 4)
                                 if peak_rate else None),
+                "mfu_vs_achievable": round(
+                    step_flops / dt_compute / achievable, 4),
+                "achievable_tflops_probe": round(achievable / 1e12, 1),
                 "mfu_e2e": (round(step_flops / dt_e2e / peak_rate, 4)
                             if peak_rate else None),
                 "hot_transfer_MBps": round(hot_mbps, 1),
@@ -262,9 +320,12 @@ def bench_ncf(smoke: bool) -> dict:
          tuple(np.asarray(a) for a in hb[0].y), hb[0].w),
         6.0 * _param_count(est.engine.params) * batch)
 
-    # 1) compute-only: device-resident batches
+    # 1) compute-only: device-resident batches — per-dispatch loop AND a
+    #    scanned (dispatch-free) run; the scanned one is the chip rate
     dev = [it._put_batch(b) for b in hb]
     dt_compute = _compute_loop(est.engine, dev, steps)
+    dt_scanned = _compute_loop_scanned(est.engine, dev[0],
+                                       max(steps, 50))
 
     hot_mbps = _hot_mbps(hb[0].x[0])
 
@@ -283,13 +344,15 @@ def bench_ncf(smoke: bool) -> dict:
     nchip = max(jax.device_count(), 1)
     peak_rate = sum(_peak_flops(d) for d in jax.devices())
     per_chip = batch / dt / nchip
-    comp = batch / dt_compute / nchip
+    comp = batch / dt_scanned / nchip
     return {"metric": "ncf_movielens_train_throughput_per_chip",
             "value": round(per_chip, 1), "unit": "samples/sec/chip",
             "vs_baseline": round(per_chip / NCF_BASELINE, 3),
             "compute_samples_per_sec_per_chip": round(comp, 1),
             "compute_vs_baseline": round(comp / NCF_BASELINE, 3),
-            "mfu_compute": (round(step_flops / dt_compute / peak_rate, 4)
+            "compute_dispatch_loop_per_chip": round(
+                batch / dt_compute / nchip, 1),
+            "mfu_compute": (round(step_flops / dt_scanned / peak_rate, 4)
                             if peak_rate else None),
             "hot_transfer_MBps": round(hot_mbps, 1),
             "transfer_limited": bool(hot_mbps < 200.0),
@@ -354,6 +417,8 @@ def bench_fraud_mlp(smoke: bool) -> dict:
             break
     dev = [it._put_batch(b) for b in hb]
     dt_compute = _compute_loop(inner.engine, dev, 12 if smoke else 40)
+    dt_scanned = _compute_loop_scanned(inner.engine, dev[0],
+                                       50 if smoke else 100)
 
     hot_mbps = _hot_mbps(hb[0].x[0])
 
@@ -366,7 +431,7 @@ def bench_fraud_mlp(smoke: bool) -> dict:
     nchip = max(jax.device_count(), 1)
     peak_rate = sum(_peak_flops(d) for d in jax.devices())
     per_chip = samples / dt / nchip
-    comp = batch / dt_compute / nchip
+    comp = batch / dt_scanned / nchip
     # no published reference number; estimate: this 4-layer MLP on one A100
     # sustains ~8M samples/s (batch-bound) -> scaled constant like NCF's
     base = 8_000_000.0
@@ -375,7 +440,9 @@ def bench_fraud_mlp(smoke: bool) -> dict:
             "vs_baseline": round(per_chip / base, 3),
             "compute_samples_per_sec_per_chip": round(comp, 1),
             "compute_vs_baseline": round(comp / base, 3),
-            "mfu_compute": (round(step_flops / dt_compute / peak_rate, 4)
+            "compute_dispatch_loop_per_chip": round(
+                batch / dt_compute / nchip, 1),
+            "mfu_compute": (round(step_flops / dt_scanned / peak_rate, 4)
                             if peak_rate else None),
             "hot_transfer_MBps": round(hot_mbps, 1),
             "transfer_limited": bool(hot_mbps < 200.0),
@@ -567,13 +634,31 @@ def bench_attention(smoke: bool) -> dict:
         return (time.perf_counter() - t0) / steps
 
     jit_ref, jit_flash = make(mha_reference), make(flash_attention)
+
+    def make_grad(fn):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: fn(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2)))
+        jax.tree_util.tree_leaves(g(*qkv))[0].block_until_ready()
+        def run():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = g(*qkv)
+            float(jnp.sum(jax.tree_util.tree_leaves(out)[0][..., :1]))
+            return (time.perf_counter() - t0) / steps
+        return run
+
+    grad_ref, grad_flash = make_grad(mha_reference), make_grad(flash_attention)
     # the shared dev chip shows large run-to-run contention; interleave
     # rounds and take each implementation's best (min is robust to spikes)
-    refs, flashes = [], []
+    refs, flashes, grefs, gflashes = [], [], [], []
     for _ in range(3 if smoke else 5):
         refs.append(one_round(jit_ref))
         flashes.append(one_round(jit_flash))
+        grefs.append(grad_ref())
+        gflashes.append(grad_flash())
     dt_ref, dt_flash = min(refs), min(flashes)
+    dt_gref, dt_gflash = min(grefs), min(gflashes)
     # attention FLOPs: 2 matmuls, causal halves the work
     flops = 4 * b * h * s * s * d / 2
     return {"metric": "flash_attention_speedup_vs_materialized",
@@ -583,6 +668,9 @@ def bench_attention(smoke: bool) -> dict:
             "seq_len": s, "heads": h, "head_dim": d, "batch": b,
             "flash_ms": round(dt_flash * 1e3, 2),
             "materialized_ms": round(dt_ref * 1e3, 2),
+            "train_speedup_fwd_bwd": round(dt_gref / dt_gflash, 2),
+            "flash_fwd_bwd_ms": round(dt_gflash * 1e3, 2),
+            "materialized_fwd_bwd_ms": round(dt_gref * 1e3, 2),
             "flash_tflops": round(flops / dt_flash / 1e12, 2)}
 
 

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""QA ranking with KNRM over TextSet relations (reference:
+pyzoo/zoo/examples/qaranker/qa_ranker.py — question/answer corpora +
+relations through TextSet.from_relation_pairs/lists into KNRM, evaluated
+with NDCG/MAP).
+
+Synthetic QA corpus: each question has topical answers (sharing its
+vocabulary) and off-topic distractors; KNRM's kernel-pooled match signal
+must rank the on-topic answers above the distractors.
+
+Usage:
+    python examples/qaranker/qa_ranker_knrm.py --smoke
+"""
+
+import argparse
+
+import numpy as np
+
+TOPIC_WORDS = {
+    t: [f"{t}w{i}" for i in range(12)]
+    for t in ("finance", "sports", "science", "travel", "food", "music")
+}
+COMMON = "what how the is of a for in to do".split()
+
+
+def synthetic_qa(n_questions, n_pos=2, n_neg=4, seed=0):
+    rng = np.random.RandomState(seed)
+    topics = list(TOPIC_WORDS)
+    q_texts, a_texts, relations = {}, {}, []
+    for qi in range(n_questions):
+        topic = topics[rng.randint(len(topics))]
+        words = TOPIC_WORDS[topic]
+        qid = f"q{qi}"
+        q_texts[qid] = " ".join(
+            [COMMON[rng.randint(len(COMMON))] for _ in range(3)]
+            + [words[rng.randint(len(words))] for _ in range(4)])
+        for pi in range(n_pos):
+            aid = f"a{qi}p{pi}"
+            a_texts[aid] = " ".join(
+                [words[rng.randint(len(words))] for _ in range(8)])
+            relations.append((qid, aid, 1))
+        for ni in range(n_neg):
+            other = topics[(topics.index(topic) + 1 + rng.randint(
+                len(topics) - 1)) % len(topics)]
+            aid = f"a{qi}n{ni}"
+            a_texts[aid] = " ".join(
+                [TOPIC_WORDS[other][rng.randint(12)] for _ in range(8)])
+            relations.append((qid, aid, 0))
+    return q_texts, a_texts, relations
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--questions", type=int, default=400)
+    p.add_argument("--q-len", type=int, default=8)
+    p.add_argument("--a-len", type=int, default=10)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.questions, args.epochs = 120, 3
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.feature.text import TextSet
+    from analytics_zoo_tpu.feature.text.text_set import TextFeature
+    from analytics_zoo_tpu.models import KNRM
+
+    init_orca_context("local")
+    try:
+        q_texts, a_texts, relations = synthetic_qa(args.questions)
+        q_corpus = TextSet([TextFeature(t, uri=u)
+                            for u, t in q_texts.items()])
+        a_corpus = TextSet([TextFeature(t, uri=u)
+                            for u, t in a_texts.items()])
+        q_corpus.tokenize().normalize().word2idx()
+        vocab = q_corpus.get_word_index()
+        a_corpus.tokenize().normalize().word2idx(existing_map=vocab)
+        vocab = {**vocab, **a_corpus.get_word_index()}
+        q_corpus.shape_sequence(len=args.q_len)
+        a_corpus.shape_sequence(len=args.a_len)
+
+        n_train_q = int(0.8 * args.questions)
+        train_rel = [r for r in relations if int(r[0][1:]) < n_train_q]
+        test_rel = [r for r in relations if int(r[0][1:]) >= n_train_q]
+
+        train_set = TextSet.from_relation_lists(train_rel, q_corpus,
+                                                a_corpus)
+        x, y = train_set.to_arrays()
+        x = x.reshape(-1, args.q_len + args.a_len)
+        y = y.reshape(-1).astype(np.float32)
+
+        knrm = KNRM(text1_length=args.q_len, text2_length=args.a_len,
+                    vocab_size=len(vocab) + 1, embed_size=32,
+                    target_mode="classification")
+        knrm.compile(loss="binary_crossentropy", optimizer="adam")
+        knrm.fit({"x": x, "y": y.reshape(-1, 1)}, epochs=args.epochs,
+                 batch_size=128, verbose=False)
+
+        # listwise evaluation on held-out questions: NDCG@3 and MAP
+        test_set = TextSet.from_relation_lists(test_rel, q_corpus, a_corpus)
+        ndcgs, maps = [], []
+        for f in test_set.features:
+            xs = f.indices.reshape(-1, args.q_len + args.a_len)
+            labels = np.asarray(f.label).reshape(-1)
+            scores = np.asarray(knrm.predict(xs)).reshape(-1)
+            from analytics_zoo_tpu.models.common.ranker import (
+                mean_average_precision, ndcg)
+            ndcgs.append(ndcg(labels, scores, k=3))
+            maps.append(mean_average_precision(labels, scores))
+        print(f"held-out ranking over {len(ndcgs)} questions: "
+              f"NDCG@3={np.mean(ndcgs):.3f} MAP={np.mean(maps):.3f} "
+              f"(random ~0.5)")
+        assert np.mean(ndcgs) > 0.6, "KNRM failed to rank topical answers"
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

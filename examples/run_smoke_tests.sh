@@ -25,7 +25,9 @@ for script in \
     examples/textclassification/news_text_classification.py \
     examples/anomalydetection/anomaly_detection_time_series.py \
     examples/vision/image_augmentation.py \
-    examples/automl/auto_xgboost_fit.py; do
+    examples/automl/auto_xgboost_fit.py \
+    examples/qaranker/qa_ranker_knrm.py \
+    examples/friesian/recsys_feature_engineering.py; do
   echo "=== $script --smoke"
   python "$script" --smoke
 done
