@@ -108,7 +108,13 @@ class Seq2SeqNet(nn.Module):
         _, carries = self.encoder(src)
         out = self.decoder(tgt, self._bridge(carries))
         if self.tgt_vocab:
-            return self.generator(out)
+            # probabilities, not logits: the estimator's Keras-style loss
+            # names follow the Keras from_logits=False contract, so a raw
+            # Dense head would silently mis-train with
+            # "sparse_categorical_crossentropy" (log of unclipped logits
+            # drives the loss to 0 while predictions stay random —
+            # round-3 chatbot example caught this)
+            return nn.softmax(self.generator(out), axis=-1)
         return out
 
 

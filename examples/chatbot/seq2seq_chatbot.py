@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Seq2Seq chatbot-style sequence transduction (reference:
+zoo/.../examples/chatbot + models/seq2seq/Seq2seq.scala:302 — encoder/
+decoder RNN with bridge and generator head, teacher-forced training then
+greedy inference).
+
+Toy "language": the bot must answer a token sequence with its reversal
+prefixed by a start token — a fully learnable deterministic dialogue task
+that exercises the same encoder/decoder/bridge/infer machinery a chatbot
+corpus would.
+
+Usage:
+    python examples/chatbot/seq2seq_chatbot.py --smoke
+"""
+
+import argparse
+
+import numpy as np
+
+PAD, START = 0, 1
+VOCAB = 24
+SEQ = 6
+
+
+def make_dialogs(n, seed=0):
+    rng = np.random.RandomState(seed)
+    src = rng.randint(2, VOCAB, (n, SEQ)).astype(np.int32)
+    reply = src[:, ::-1]                       # the "answer" = reversal
+    tgt_in = np.concatenate(
+        [np.full((n, 1), START, np.int32), reply[:, :-1]], axis=1)
+    tgt_out = reply
+    return src, tgt_in, tgt_out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=20_000)
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.rows, args.epochs = 8000, 10
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.models import Seq2Seq
+
+    init_orca_context("local")
+    try:
+        src, tgt_in, tgt_out = make_dialogs(args.rows)
+        s2s = Seq2Seq(rnn_type="gru", nlayers=1, hidden_size=96,
+                      src_vocab=VOCAB, tgt_vocab=VOCAB, embed_dim=32,
+                      bridge="dense")
+        s2s.compile(loss="sparse_categorical_crossentropy",
+                    optimizer="adam")
+        s2s.fit({"x": (src, tgt_in), "y": tgt_out}, epochs=args.epochs,
+                batch_size=256, verbose=False)
+
+        # greedy inference on held-out prompts
+        test_src, _, test_expect = make_dialogs(500, seed=1)
+        gen = s2s.infer(test_src, start_sign=START,
+                        max_seq_len=SEQ + 1)[:, 1:]   # drop start token
+        tok_acc = float((gen == test_expect).mean())
+        exact = float((gen == test_expect).all(axis=1).mean())
+        print(f"held-out reply accuracy: {tok_acc:.3f} per-token, "
+              f"{exact:.3f} exact-sequence (random {1 / (VOCAB - 2):.3f})")
+        assert tok_acc > 0.5, "seq2seq failed to learn the toy dialogue"
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

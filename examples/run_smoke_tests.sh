@@ -27,7 +27,9 @@ for script in \
     examples/vision/image_augmentation.py \
     examples/automl/auto_xgboost_fit.py \
     examples/qaranker/qa_ranker_knrm.py \
-    examples/friesian/recsys_feature_engineering.py; do
+    examples/friesian/recsys_feature_engineering.py \
+    examples/gan/mnist_gan.py \
+    examples/chatbot/seq2seq_chatbot.py; do
   echo "=== $script --smoke"
   python "$script" --smoke
 done
