@@ -1,3 +1,5 @@
+from .families import (AlexNet, DenseNet121, MobileNetV1, MobileNetV2,
+                       SqueezeNet, VGG, VGG16, VGG19)
 from .classifier import (IMAGENET_TOP_CONFIGS, ImageClassifier,
                          LabelOutput)
 from .inception import InceptionV1

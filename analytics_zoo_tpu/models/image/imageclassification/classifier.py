@@ -28,7 +28,17 @@ def _resnet_factory(depth):
     return make
 
 
-# the reference's model-definition family ("imageclassification" configs)
+def _family_factory(cls, **fixed):
+    def make(num_classes, **kw):
+        from . import families
+        return getattr(families, cls)(num_classes=num_classes,
+                                      **{**fixed, **kw})
+    return make
+
+
+# the reference's model-definition family ("imageclassification" configs):
+# Alexnet, Inception-V1, VGG, Resnet, Densenet, Mobilenet, Squeezenet
+# (docs/docs/ProgrammingGuide/image-classification.md:5)
 IMAGENET_TOP_CONFIGS: Dict[str, Callable] = {
     "inception-v1": lambda num_classes, **kw: InceptionV1(
         num_classes=num_classes, **kw),
@@ -37,6 +47,13 @@ IMAGENET_TOP_CONFIGS: Dict[str, Callable] = {
     "resnet-50": _resnet_factory(50),
     "resnet-101": _resnet_factory(101),
     "resnet-152": _resnet_factory(152),
+    "alexnet": _family_factory("AlexNet"),
+    "vgg-16": _family_factory("VGG16"),
+    "vgg-19": _family_factory("VGG19"),
+    "mobilenet": _family_factory("MobileNetV1"),
+    "mobilenet-v2": _family_factory("MobileNetV2"),
+    "squeezenet": _family_factory("SqueezeNet"),
+    "densenet-121": _family_factory("DenseNet121"),
 }
 
 

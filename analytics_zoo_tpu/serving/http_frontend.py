@@ -102,8 +102,51 @@ def main(argv=None):
                         "redis://host:port/<stream>)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=10020)
+    p.add_argument("--model", default=None,
+                   help="also start an embedded ClusterServing worker on "
+                        "the same broker: estimator checkpoint pickle "
+                        "(InferenceModel.save), SavedModel/.h5 keras model, "
+                        "or an export_tf folder (frozen_inference_graph.pb "
+                        "+ graph_meta.json) — single-container serving. A "
+                        "bare frozen .pb needs tensor names: use "
+                        "--tf-inputs/--tf-outputs")
+    p.add_argument("--tf-inputs", default=None,
+                   help="comma-separated input tensor names for a bare "
+                        "frozen .pb (e.g. 'input:0')")
+    p.add_argument("--tf-outputs", default=None,
+                   help="comma-separated output tensor names for a bare "
+                        "frozen .pb")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--batch-timeout-ms", type=float, default=5.0)
     args = p.parse_args(argv)
-    run_frontend(queue=args.queue, host=args.host, port=args.port)
+
+    serving = None
+    if args.model:
+        import os
+
+        from ..pipeline.inference import InferenceModel
+        from .engine import ClusterServing
+
+        model = InferenceModel()
+        path = args.model
+        if (path.endswith(".pb") or path.endswith(".h5")
+                or os.path.isdir(path)):
+            model.load_tf(
+                path,
+                input_names=(args.tf_inputs.split(",")
+                             if args.tf_inputs else None),
+                output_names=(args.tf_outputs.split(",")
+                              if args.tf_outputs else None))
+        else:
+            model.load(path)
+        serving = ClusterServing(
+            model, queue=args.queue, batch_size=args.batch_size,
+            batch_timeout_ms=args.batch_timeout_ms).start()
+    try:
+        run_frontend(queue=args.queue, host=args.host, port=args.port)
+    finally:
+        if serving is not None:
+            serving.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,13 @@
+# Cluster-Serving image — analogue of the reference's cluster-serving
+# docker (Flink job + Redis + zoo jar; docker/cluster-serving). One
+# container = broker (MiniRedis) + batching engine + HTTP frontend.
+#
+#   docker build -t zoo-tpu-serving -f docker/serving.Dockerfile .
+#   docker run -p 8080:8080 -v /path/to/model.pkl:/model.pkl zoo-tpu-serving
+FROM analytics-zoo-tpu
+
+EXPOSE 8080
+# zoo-serving: the console entry point (analytics_zoo_tpu.serving.http_frontend)
+# --model: estimator checkpoint pickle (InferenceModel.save) or SavedModel dir
+CMD ["zoo-serving", "--model", "/model.pkl", "--port", "8080", \
+     "--queue", "memory://serving_stream"]

@@ -54,7 +54,7 @@ def test_classifier_config_family(orca_context):
     clf.fit({"x": x, "y": y}, epochs=1, batch_size=16, verbose=False)
     assert clf.predict_image_set(x[:2]).shape == (2, 4)
     with pytest.raises(ValueError):
-        ImageClassifier("vgg-19")
+        ImageClassifier("no-such-config")   # vgg-19 etc. exist since round 3
 
 
 def test_classifier_save_load_roundtrip(orca_context, tmp_path):
@@ -77,3 +77,43 @@ def test_label_output():
     out = LabelOutput({0: "a", 1: "b", 2: "c"}, top_k=2)(probs)
     assert out[0][0] == ("b", pytest.approx(0.7))
     assert out[0][1] == ("c", pytest.approx(0.2))
+
+
+@pytest.mark.parametrize("name", ["alexnet", "vgg-16", "mobilenet",
+                                  "mobilenet-v2", "squeezenet",
+                                  "densenet-121"])
+def test_model_family_forward_shapes(orca_context, name):
+    """Round 3: the rest of the reference's published config family
+    (image-classification.md:5 — Alexnet/VGG/Mobilenet/Squeezenet/Densenet)
+    as flax modules. Forward contract: softmax probabilities over classes."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        IMAGENET_TOP_CONFIGS)
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    # default: logits (the ImageClassifier family convention, so compile()'s
+    # from_logits loss and predict_image_set's softmax are correct)
+    net = IMAGENET_TOP_CONFIGS[name](num_classes=7,
+                                     compute_dtype=jnp.float32)
+    v = net.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out = np.asarray(net.apply(v, x, train=False))
+    assert out.shape == (2, 7)
+    assert not np.allclose(out.sum(-1), 1.0)      # logits, not probs
+    # return_logits=False flips the head to probabilities
+    pnet = IMAGENET_TOP_CONFIGS[name](num_classes=7,
+                                      compute_dtype=jnp.float32,
+                                      return_logits=False)
+    pout = np.asarray(pnet.apply(v, x, train=False))
+    np.testing.assert_allclose(pout.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_mobilenet_trains_on_toy_data(orca_context):
+    x, y = _toy_images(n=32, size=32, classes=3)
+    clf = ImageClassifier("mobilenet-v2", num_classes=3)
+    clf.compile()       # default from_logits loss pairs with logits heads
+    stats = clf.fit({"x": x, "y": y}, epochs=4, batch_size=16,
+                    verbose=False)
+    assert stats[-1]["train_loss"] < stats[0]["train_loss"]
+    probs = clf.predict_image_set(x[:2])
+    np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, rtol=1e-4)

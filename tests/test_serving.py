@@ -218,3 +218,75 @@ def test_tfnet_frozen_graph_roundtrip(tmp_path):
     # serving-side: the same frozen graph behind InferenceModel.predict
     im = net_back.as_inference_model()
     np.testing.assert_allclose(im.predict(x), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_zoo_serving_cli_embedded_worker(tmp_path):
+    """Round 3: ``zoo-serving --model ckpt`` starts an embedded
+    ClusterServing worker alongside the HTTP frontend (single-container
+    serving; the reference needs a Flink job + Redis + frontend)."""
+    import threading
+
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import InMemoryBroker, InputQueue
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4), np.float32))
+    im = InferenceModel().load_jax(module, variables)
+    ckpt = tmp_path / "model.pkl"
+    im.save(module, str(ckpt))
+
+    # drive main() far enough to build the worker; stub the blocking
+    # frontend (aiohttp's run_app needs the main thread) with an event so
+    # the embedded worker stays alive while we serve through the broker
+    from analytics_zoo_tpu.serving import http_frontend
+    from analytics_zoo_tpu.serving.engine import ClusterServing
+    from analytics_zoo_tpu.serving.http_frontend import main
+
+    started = {}
+    release = threading.Event()
+    orig_start = ClusterServing.start
+    orig_frontend = http_frontend.run_frontend
+
+    def capture_start(self, example=None):
+        started["serving"] = self
+        return orig_start(self, example)
+
+    ClusterServing.start = capture_start
+    http_frontend.run_frontend = lambda **kw: release.wait(60)
+    try:
+        t = threading.Thread(
+            target=main,
+            args=(["--model", str(ckpt), "--queue", "memory://cli-test"],),
+            daemon=True)
+        t.start()
+        for _ in range(200):
+            if "serving" in started:
+                break
+            import time
+            time.sleep(0.05)
+        assert "serving" in started, "worker did not start"
+        iq = InputQueue(queue="memory://cli-test")
+        broker = iq.broker
+        assert isinstance(broker, InMemoryBroker)
+        iq.enqueue("r1", t=np.ones(4, np.float32))
+        raw = broker.get_result("r1", timeout_s=30)
+        assert raw is not None
+        from analytics_zoo_tpu.serving.codecs import decode_payload
+        data, _ = decode_payload(raw)
+        assert np.asarray(data).shape == (3,)
+    finally:
+        release.set()
+        t.join(timeout=10)
+        ClusterServing.start = orig_start
+        http_frontend.run_frontend = orig_frontend
+        if "serving" in started:
+            started["serving"].stop()
