@@ -149,11 +149,16 @@ class _InvertedResidual(nn.Module):
 
 
 class MobileNetV2(nn.Module):
-    """MobileNet-V2 (arXiv:1801.04381): inverted residuals."""
+    """MobileNet-V2 (arXiv:1801.04381): inverted residuals.
+
+    ``return_features=True`` skips the classifier and returns the
+    (stride-16, stride-32) feature maps — the taps SSD-MobileNet detection
+    heads hang off (objectdetection/ssd.py SSDMobileNetV2)."""
     num_classes: int = 1000
     compute_dtype: Any = jnp.bfloat16
     return_logits: bool = True      # classifier-family convention, like
                                     # models/image/resnet.py
+    return_features: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -164,13 +169,18 @@ class MobileNetV2(nn.Module):
         # (expand, features, repeats, first-stride)
         plan = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
                 (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        f16 = None
         for bi, (t, c, n, s) in enumerate(plan):
             for ri in range(n):
                 x = _InvertedResidual(
                     features=c, stride=s if ri == 0 else 1, expand=t,
                     dtype=dt, name=f"block{bi}_{ri}")(x, train=train)
+            if bi == 4:                     # end of the stride-16 stages
+                f16 = x
         x = _conv_bn_act(x, 1280, (1, 1), (1, 1), dt, "head",
                          act=nn.relu6, train=train)
+        if self.return_features:
+            return f16, x                   # stride 16, stride 32
         x = jnp.mean(x, axis=(1, 2))
         logits = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return logits if self.return_logits else nn.softmax(logits)

@@ -10,14 +10,16 @@ from .detector import (COCO_CLASSES, PASCAL_CLASSES, ObjectDetector,
 from .loss import match_priors, multibox_loss
 from .postprocess import decode_detections, nms, scale_detections
 from .priors import PriorSpec, generate_priors, ssd300_specs, tiny_specs
-from .ssd import SSD, ssd_300, ssd_tiny
+from .ssd import (SSD, SSDMobileNetV2, ssd_300,
+                  ssd_mobilenet_specs, ssd_tiny)
 
 __all__ = [
     "DEFAULT_VARIANCES", "center_to_corner", "corner_to_center",
     "clip_boxes", "decode_boxes", "encode_boxes", "iou_matrix",
     "match_priors", "multibox_loss", "decode_detections", "nms",
     "scale_detections", "PriorSpec", "generate_priors", "ssd300_specs",
-    "tiny_specs", "SSD", "ssd_300", "ssd_tiny", "ObjectDetector",
+    "tiny_specs", "SSD", "SSDMobileNetV2", "ssd_300", "ssd_tiny",
+    "ssd_mobilenet_specs", "ObjectDetector",
     "Visualizer", "read_pascal_label_map", "read_coco_label_map",
     "PASCAL_CLASSES", "COCO_CLASSES",
 ]

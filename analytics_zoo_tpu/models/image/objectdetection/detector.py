@@ -69,9 +69,13 @@ class ObjectDetector(ZooModel):
         elif model_type == "ssd_tiny":
             module = ssd_tiny(num_classes, image_size=image_size,
                               **net_kwargs)
+        elif model_type == "ssd_mobilenet_v2":
+            from .ssd import SSDMobileNetV2
+            module = SSDMobileNetV2(num_classes=num_classes,
+                                    image_size=image_size, **net_kwargs)
         else:
             raise ValueError(f"unknown model_type {model_type!r} "
-                             "(known: ssd300, ssd_tiny)")
+                             "(known: ssd300, ssd_tiny, ssd_mobilenet_v2)")
         super().__init__(module)
         self.priors = module.priors()
 

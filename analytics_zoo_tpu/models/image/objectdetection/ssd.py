@@ -112,3 +112,78 @@ def ssd_tiny(num_classes: int, image_size: int = 64,
     return SSD(num_classes=num_classes, image_size=image_size,
                specs=tuple(tiny_specs(image_size)), base_width=base_width,
                max_width=64)
+
+
+def _mobilenet_chain(image_size: int) -> Sequence[int]:
+    """Feature-map sizes of the MobileNet-SSD pyramid: the backbone's
+    stride-16 tap, its stride-32 head, then stride-2 extras to 1x1."""
+    s = image_size
+    for _ in range(4):                     # stem + three stride-2 stages
+        s = -(-s // 2)
+    sizes = [s]                            # stride 16
+    while s > 1:
+        s = -(-s // 2)
+        sizes.append(s)
+    return sizes
+
+
+def ssd_mobilenet_specs(image_size: int = 300) -> Sequence[PriorSpec]:
+    """Prior schedule over the MobileNet pyramid (e.g. 19/10/5/3/2/1 at
+    300), standard SSD scale interpolation 0.2 -> 0.95."""
+    sizes = _mobilenet_chain(image_size)
+    n = len(sizes)
+    lo, hi = 0.2, 0.95
+    scales = [lo + (hi - lo) * i / max(n - 1, 1) for i in range(n)] + [1.0]
+    return [PriorSpec(fm, scales[i] * image_size, scales[i + 1] * image_size,
+                      (2.0, 3.0) if 0 < i < 4 else (2.0,))
+            for i, fm in enumerate(sizes)]
+
+
+class SSDMobileNetV2(nn.Module):
+    """SSD with a MobileNet-V2 backbone (the reference ships SSD-MobileNet
+    artifacts alongside SSD-VGG, docs ProgrammingGuide/object-detection.md;
+    Scala pipeline: models/image/objectdetection/ssd/). Detection heads tap
+    the backbone's stride-16/32 features, then stride-2 extra convs extend
+    the pyramid to 1x1."""
+    num_classes: int                        # including background class 0
+    image_size: int = 300
+
+    def _specs(self) -> Sequence[PriorSpec]:
+        return ssd_mobilenet_specs(self.image_size)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False):
+        from ..imageclassification.families import MobileNetV2, _conv_bn_act
+
+        compute_dtype = jnp.bfloat16 if x.dtype == jnp.bfloat16 else x.dtype
+        f16, f32 = MobileNetV2(return_features=True,
+                               compute_dtype=compute_dtype,
+                               name="backbone")(x, train=train)
+        feats = [f16, f32]
+        h = f32
+        width = 256
+        i = 0
+        while h.shape[1] > 1:
+            h = _conv_bn_act(h, width, (3, 3), (2, 2), compute_dtype,
+                             f"extra{i}", train=train)
+            feats.append(h)
+            i += 1
+
+        locs, confs = [], []
+        for sp, f in zip(self._specs(), feats):
+            assert f.shape[1] == sp.fm_size, (f.shape, sp)
+            k = sp.num_priors
+            loc = nn.Conv(k * 4, (3, 3), dtype=compute_dtype,
+                          name=f"loc{sp.fm_size}")(f)
+            conf = nn.Conv(k * self.num_classes, (3, 3),
+                           dtype=compute_dtype,
+                           name=f"conf{sp.fm_size}")(f)
+            b = loc.shape[0]
+            locs.append(loc.reshape(b, -1, 4))
+            confs.append(conf.reshape(b, -1, self.num_classes))
+        loc = jnp.concatenate(locs, axis=1).astype(jnp.float32)
+        conf = jnp.concatenate(confs, axis=1).astype(jnp.float32)
+        return loc, conf
+
+    def priors(self) -> np.ndarray:
+        return generate_priors(self.image_size, self._specs())

@@ -251,3 +251,33 @@ def test_detector_serving_e2e(orca_context):
     for r in results:
         arr = r if isinstance(r, np.ndarray) else r.get("prediction", r)
         assert np.asarray(arr).shape == (10, 6)
+
+
+def test_ssd_mobilenet_v2_forward_and_priors(orca_context):
+    """Round 3: SSD over the MobileNet-V2 backbone (reference ships
+    SSD-MobileNet alongside SSD-VGG). Heads and priors must agree on the
+    anchor count, and the detector surface must train one step."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        ObjectDetector, SSDMobileNetV2)
+
+    net = SSDMobileNetV2(num_classes=4, image_size=64)
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    v = net.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    loc, conf = net.apply(v, x, train=False)
+    priors = net.priors()
+    assert loc.shape == (2, priors.shape[0], 4)
+    assert conf.shape == (2, priors.shape[0], 4)
+
+    det = ObjectDetector(class_names=("a", "b", "c"), image_size=64,
+                         model_type="ssd_mobilenet_v2", max_gt=4)
+    det.compile(optimizer="adam")
+    rng = np.random.RandomState(1)
+    imgs = rng.rand(8, 64, 64, 3).astype(np.float32)
+    boxes = [np.asarray([[0.2, 0.2, 0.6, 0.6]], np.float32)] * 8
+    labels = [np.ones(1, np.int32)] * 8
+    y = ObjectDetector.pack_targets(boxes, labels, max_gt=4)
+    stats = det.fit({"x": imgs, "y": y}, batch_size=4, epochs=1)
+    assert np.isfinite(stats[-1]["train_loss"])
