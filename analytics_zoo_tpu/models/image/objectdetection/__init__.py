@@ -10,6 +10,7 @@ from .detector import (COCO_CLASSES, PASCAL_CLASSES, ObjectDetector,
 from .loss import match_priors, multibox_loss
 from .postprocess import decode_detections, nms, scale_detections
 from .priors import PriorSpec, generate_priors, ssd300_specs, tiny_specs
+from .evaluation import voc_detection_map
 from .ssd import (SSD, SSDMobileNetV2, ssd_300,
                   ssd_mobilenet_specs, ssd_tiny)
 
@@ -19,7 +20,7 @@ __all__ = [
     "match_priors", "multibox_loss", "decode_detections", "nms",
     "scale_detections", "PriorSpec", "generate_priors", "ssd300_specs",
     "tiny_specs", "SSD", "SSDMobileNetV2", "ssd_300", "ssd_tiny",
-    "ssd_mobilenet_specs", "ObjectDetector",
+    "ssd_mobilenet_specs", "ObjectDetector", "voc_detection_map",
     "Visualizer", "read_pascal_label_map", "read_coco_label_map",
     "PASCAL_CLASSES", "COCO_CLASSES",
 ]

@@ -131,6 +131,25 @@ class ObjectDetector(ZooModel):
             out[i] = scale_detections(dets[i], w, h)
         return out
 
+    def evaluate_map(self, images, gt_boxes, gt_labels,
+                     iou_threshold: float = 0.5, use_07_metric: bool = False,
+                     score_threshold: float = 0.05, **predict_kwargs):
+        """PASCAL-VOC mean average precision over a labeled image set
+        (reference validation metric: MeanAveragePrecision). ``gt_boxes``
+        are normalized [0,1] corner boxes (the training-target convention);
+        ``gt_labels`` 1-based class ids. Returns {"mAP", "ap_per_class"}."""
+        from .evaluation import voc_detection_map
+        dets = self.predict_image_set(images,
+                                      score_threshold=score_threshold,
+                                      **predict_kwargs)
+        scale = float(self.image_size)
+        gt_px = [np.asarray(b, np.float32).reshape(-1, 4) * scale
+                 for b in gt_boxes]
+        return voc_detection_map(
+            list(dets), gt_px, list(gt_labels),
+            num_classes=len(self.class_names) + 1,
+            iou_threshold=iou_threshold, use_07_metric=use_07_metric)
+
     def as_inference_model(self, score_threshold: float = 0.05,
                            nms_threshold: float = 0.45,
                            max_detections: int = 100):

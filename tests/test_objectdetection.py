@@ -281,3 +281,55 @@ def test_ssd_mobilenet_v2_forward_and_priors(orca_context):
     y = ObjectDetector.pack_targets(boxes, labels, max_gt=4)
     stats = det.fit({"x": imgs, "y": y}, batch_size=4, epochs=1)
     assert np.isfinite(stats[-1]["train_loss"])
+
+
+def test_voc_map_hand_computed():
+    """Round 3: VOC mAP (the reference's MeanAveragePrecision validation
+    metric) against hand-computed expectations."""
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        voc_detection_map)
+
+    gt_boxes = [np.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)]
+    gt_labels = [np.asarray([1, 1])]
+
+    # perfect: both GTs matched -> AP 1
+    perfect = [np.asarray([[1, 0.9, 0, 0, 10, 10],
+                           [1, 0.8, 20, 20, 30, 30]], np.float32)]
+    res = voc_detection_map(perfect, gt_boxes, gt_labels, num_classes=2)
+    assert res["mAP"] == pytest.approx(1.0)
+
+    # one GT found + one duplicate on the same GT (FP), other GT missed:
+    # PR points (1, 0.5) then (0.5, 0.5) -> all-points AP = 0.5
+    dup = [np.asarray([[1, 0.9, 0, 0, 10, 10],
+                       [1, 0.8, 0, 0, 10, 10]], np.float32)]
+    res = voc_detection_map(dup, gt_boxes, gt_labels, num_classes=2)
+    assert res["mAP"] == pytest.approx(0.5)
+
+    # off-target box (IoU < 0.5) counts as FP even when it is the only det
+    miss = [np.asarray([[1, 0.9, 100, 100, 120, 120]], np.float32)]
+    res = voc_detection_map(miss, gt_boxes, gt_labels, num_classes=2)
+    assert res["mAP"] == pytest.approx(0.0)
+
+    # padded rows (score<=0) must be ignored
+    padded = [np.concatenate([perfect[0],
+                              np.asarray([[-1, 0.0, 0, 0, 0, 0]],
+                                         np.float32)])]
+    res = voc_detection_map(padded, gt_boxes, gt_labels, num_classes=2)
+    assert res["mAP"] == pytest.approx(1.0)
+
+    # classes absent from GT are excluded from the mean, not zeroed
+    res = voc_detection_map(perfect, gt_boxes, gt_labels, num_classes=5)
+    assert res["mAP"] == pytest.approx(1.0)
+    assert set(res["ap_per_class"]) == {1}
+
+
+def test_detector_evaluate_map_surface(orca_context):
+    imgs, boxes, labels = _toy_detection_data(n=12)
+    det = ObjectDetector(class_names=("square",), image_size=64,
+                         model_type="ssd_tiny", max_gt=4)
+    y = ObjectDetector.pack_targets(boxes, labels, max_gt=4)
+    det.compile(optimizer="adam")
+    det.fit({"x": imgs, "y": y}, batch_size=4, epochs=8)
+    res = det.evaluate_map(imgs, boxes, labels)
+    assert 0.0 <= res["mAP"] <= 1.0
+    assert res["mAP"] > 0.3, res     # trained on this data; must find squares
