@@ -60,12 +60,12 @@ class AutoTSTrainer:
                     vx, vy = x, y
                 forecaster = trainer._build_forecaster(
                     model_type, cfg, tsft.feature_num)
-                target_y = y if model_type == "LSTM" and trainer.horizon == 1 \
-                    else y[..., None]
-                vtarget = vy if model_type == "LSTM" and trainer.horizon == 1 \
-                    else vy[..., None]
                 if model_type == "LSTM" and trainer.horizon == 1:
                     target_y, vtarget = y[:, 0:1], vy[:, 0:1]
+                elif model_type == "MTNet":
+                    target_y, vtarget = y, vy          # (n, horizon)
+                else:
+                    target_y, vtarget = y[..., None], vy[..., None]
                 forecaster.fit(x, target_y,
                                epochs=int(getattr(recipe, "epochs", epochs)
                                           or epochs),
@@ -105,6 +105,15 @@ class AutoTSTrainer:
                 input_feature_num=feature_num, output_feature_num=1,
                 lstm_hidden_dim=int(cfg.get("latent_dim", 64)),
                 lr=float(cfg.get("lr", 1e-3)))
+        if model_type == "MTNet":
+            from ..model.forecast import MTNetForecaster
+            return MTNetForecaster(
+                target_dim=self.horizon, feature_dim=feature_num,
+                ar_window_size=int(cfg.get("ar_size", 4)),
+                cnn_height=int(cfg.get("cnn_height", 3)),
+                cnn_hid_size=int(cfg.get("cnn_hid_size", 32)),
+                lr=float(cfg.get("lr", 1e-3)),
+                loss=cfg.get("loss", "mse"))
         return LSTMForecaster(
             target_dim=self.horizon, feature_dim=feature_num,
             lstm_units=cfg.get("lstm_units", (16, 8)),

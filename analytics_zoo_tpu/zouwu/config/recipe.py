@@ -87,3 +87,140 @@ class TCNGridRandomRecipe(Recipe):
 
     def model_type(self):
         return "TCN"
+
+
+class TCNSmokeRecipe(Recipe):
+    """(reference: recipe.py TCNSmokeRecipe)"""
+    num_samples = 1
+    training_iteration = 1
+
+    def search_space(self, all_available_features):
+        return {"num_channels": (8, 8), "kernel_size": 3, "dropout": 0.1,
+                "lr": 0.01, "batch_size": 32, "past_seq_len": 12,
+                "loss": "mse"}
+
+    def model_type(self):
+        return "TCN"
+
+
+class MTNetSmokeRecipe(Recipe):
+    """(reference: recipe.py MTNetSmokeRecipe)"""
+    num_samples = 1
+    training_iteration = 1
+
+    def search_space(self, all_available_features):
+        return {"ar_size": 2, "cnn_height": 2, "cnn_hid_size": 16,
+                "lr": 0.01, "batch_size": 32, "past_seq_len": 12,
+                "loss": "mse"}
+
+    def model_type(self):
+        return "MTNet"
+
+
+class MTNetGridRandomRecipe(Recipe):
+    """(reference: recipe.py MTNetGridRandomRecipe — grid over cnn/ar
+    geometry, random over lr/dropout)"""
+
+    def __init__(self, num_rand_samples: int = 1, training_iteration: int = 10,
+                 time_step=(12,), cnn_height=(2, 3), ar_size=(2, 4),
+                 cnn_hid_size=(16, 32), batch_size=(32, 64)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = training_iteration
+        self.time_step = list(time_step)
+        self.cnn_height = list(cnn_height)
+        self.ar_size = list(ar_size)
+        self.cnn_hid_size = list(cnn_hid_size)
+        self.batch_size = list(batch_size)
+
+    def search_space(self, all_available_features):
+        return {
+            "past_seq_len": hp.grid_search(self.time_step),
+            "cnn_height": hp.choice(self.cnn_height),
+            "ar_size": hp.choice(self.ar_size),
+            "cnn_hid_size": hp.choice(self.cnn_hid_size),
+            "batch_size": hp.grid_search(self.batch_size),
+            "lr": hp.loguniform(1e-4, 1e-2),
+            "loss": "mse",
+        }
+
+    def model_type(self):
+        return "MTNet"
+
+
+class Seq2SeqRandomRecipe(Recipe):
+    """(reference: recipe.py Seq2SeqRandomRecipe)"""
+
+    def __init__(self, num_rand_samples: int = 1, training_iteration: int = 10,
+                 latent_dim=(32, 64, 128), batch_size=(32, 64),
+                 past_seq_len=(50,)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = training_iteration
+        self.latent_dim = list(latent_dim)
+        self.batch_size = list(batch_size)
+        self.past_seq_len = list(past_seq_len)
+
+    def search_space(self, all_available_features):
+        return {
+            "latent_dim": hp.choice(self.latent_dim),
+            "batch_size": hp.grid_search(self.batch_size),
+            "past_seq_len": hp.choice(self.past_seq_len),
+            "lr": hp.loguniform(1e-4, 1e-2),
+            "loss": "mse",
+        }
+
+    def model_type(self):
+        return "Seq2Seq"
+
+
+class GridRandomRecipe(LSTMGridRandomRecipe):
+    """(reference: recipe.py GridRandomRecipe — the historical name for the
+    LSTM grid+random preset; kept as an alias surface)"""
+
+
+class RandomRecipe(Recipe):
+    """(reference: recipe.py RandomRecipe — pure random sampling, no grid
+    axes, so trial count == num_rand_samples)"""
+
+    def __init__(self, num_rand_samples: int = 1, training_iteration: int = 10,
+                 past_seq_len=(50,)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = training_iteration
+        self.past_seq_len = list(past_seq_len)
+
+    def search_space(self, all_available_features):
+        return {
+            "lstm_units": hp.sample_from(
+                lambda rng: [int(rng.choice([8, 16, 32])),
+                             int(rng.choice([8, 16]))]),
+            "dropouts": hp.uniform(0.1, 0.4),
+            "batch_size": hp.choice([32, 64]),
+            "past_seq_len": hp.choice(self.past_seq_len),
+            "lr": hp.loguniform(1e-4, 1e-1),
+            "loss": "mse",
+        }
+
+    def model_type(self):
+        return "LSTM"
+
+
+class XgbRegressorGridRandomRecipe(Recipe):
+    """(reference: recipe.py XgbRegressorGridRandomRecipe — pairs with
+    AutoXGBRegressor.fit(search_space=recipe.search_space([])))"""
+
+    def __init__(self, num_rand_samples: int = 1,
+                 n_estimators=(50, 100), max_depth=(3, 6),
+                 lr_range=(1e-2, 3e-1)):
+        self.num_samples = num_rand_samples
+        self.n_estimators = list(n_estimators)
+        self.max_depth = list(max_depth)
+        self.lr_range = tuple(lr_range)
+
+    def search_space(self, all_available_features):
+        return {
+            "n_estimators": hp.grid_search(self.n_estimators),
+            "max_depth": hp.grid_search(self.max_depth),
+            "learning_rate": hp.loguniform(*self.lr_range),
+        }
+
+    def model_type(self):
+        return "XGBoost"

