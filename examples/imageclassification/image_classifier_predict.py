@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Image classification with the config-family ImageClassifier (reference:
+pyzoo/zoo/examples/imageclassification/predict.py — load a family model,
+run an ImageSet through it, LabelOutput top-k; plus the inception training
+example family).
+
+Trains a config-family model (default resnet-18; deeper BN-heavy families
+like mobilenet-v2 need more data/epochs than the smoke corpus offers) on a
+small synthetic corpus (class = dominant hue), then predicts top-k
+(label, confidence) pairs the way the reference's predict example prints
+them.
+
+Usage:
+    python examples/imageclassification/image_classifier_predict.py --smoke
+"""
+
+import argparse
+
+import numpy as np
+
+
+def hue_corpus(n, size=48, seed=0):
+    rng = np.random.RandomState(seed)
+    classes = ("red", "green", "blue")
+    y = rng.randint(0, 3, n)
+    x = rng.rand(n, size, size, 3).astype(np.float32) * 0.3
+    for i, c in enumerate(y):
+        x[i, :, :, c] += 0.6
+    return x, y.astype(np.int32), classes
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=512)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--model", default="resnet-18",
+                   help="any IMAGENET_TOP_CONFIGS name (alexnet, vgg-16, "
+                        "resnet-50, squeezenet, densenet-121, ...)")
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.rows, args.epochs = 96, 3
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier)
+
+    init_orca_context("local")
+    try:
+        x, y, classes = hue_corpus(args.rows)
+        label_map = dict(enumerate(classes))
+        split = int(0.85 * len(x))
+
+        clf = ImageClassifier(args.model, num_classes=len(classes),
+                              label_map=label_map)
+        clf.compile()
+        clf.fit({"x": x[:split], "y": y[:split]}, epochs=args.epochs,
+                batch_size=32, verbose=False)
+
+        top = clf.predict_image_set(x[split:], top_k=2)
+        correct = sum(1 for pairs, truth in zip(top, y[split:])
+                      if pairs[0][0] == classes[truth])
+        print(f"{args.model}: top-1 accuracy "
+              f"{correct / (len(x) - split):.3f} on {len(x) - split} "
+              f"held-out images")
+        print("sample predictions:", top[:2])
+        assert correct / (len(x) - split) > 0.5
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

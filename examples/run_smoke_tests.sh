@@ -29,7 +29,8 @@ for script in \
     examples/qaranker/qa_ranker_knrm.py \
     examples/friesian/recsys_feature_engineering.py \
     examples/gan/mnist_gan.py \
-    examples/chatbot/seq2seq_chatbot.py; do
+    examples/chatbot/seq2seq_chatbot.py \
+    examples/imageclassification/image_classifier_predict.py; do
   echo "=== $script --smoke"
   python "$script" --smoke
 done
