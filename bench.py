@@ -226,13 +226,16 @@ def bench_resnet50(smoke: bool) -> dict:
             return jax.lax.fori_loop(0, 8, lambda i, acc: acc @ a, a)
         mm = jax.device_put(jnp.ones((8192, 8192), jnp.bfloat16))
         float(_mm_chain(mm)[0, 0].astype(jnp.float32))
-        t0 = time.perf_counter()
-        out = _mm_chain(mm)
-        float(out[0, 0].astype(jnp.float32))
+        best_probe = 0.0
+        for _ in range(3):      # best-of-3: shared-chip contention is spiky
+            t0 = time.perf_counter()
+            out = _mm_chain(mm)
+            float(out[0, 0].astype(jnp.float32))
+            best_probe = max(best_probe,
+                             2 * 8192**3 * 8 / (time.perf_counter() - t0))
         # the probe runs on one device; scale to the whole mesh so the
         # step-FLOPs numerator (all chips) divides a like-for-like ceiling
-        achievable = (2 * 8192**3 * 8 / (time.perf_counter() - t0)
-                      * max(jax.device_count(), 1))
+        achievable = best_probe * max(jax.device_count(), 1)
 
         # 3) end-to-end: every step assembles a fresh host batch from the
         #    memory-mapped shards and feeds it straight into the jit
