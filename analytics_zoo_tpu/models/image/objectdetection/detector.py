@@ -139,6 +139,13 @@ class ObjectDetector(ZooModel):
         are normalized [0,1] corner boxes (the training-target convention);
         ``gt_labels`` 1-based class ids. Returns {"mAP", "ap_per_class"}."""
         from .evaluation import voc_detection_map
+        if predict_kwargs.get("original_sizes") is not None:
+            raise ValueError(
+                "evaluate_map scales ground truth by the model input size; "
+                "rescaling detections to per-image original_sizes would "
+                "silently corrupt the mAP. Evaluate in input-frame coords "
+                "(drop original_sizes), or rescale both sides yourself and "
+                "call voc_detection_map directly.")
         dets = self.predict_image_set(images,
                                       score_threshold=score_threshold,
                                       **predict_kwargs)

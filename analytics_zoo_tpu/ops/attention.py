@@ -131,7 +131,8 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  sm_scale, block_q, block_k, num_k_blocks, causal):
+                  sm_scale, block_q, block_k, num_k_blocks, causal,
+                  q_offset=0):
     """Grid = (batch*heads, num_q_blocks, num_k_blocks); the k dim is innermost
     so (acc, m, l) scratch carries the online softmax across k iterations."""
     import jax.experimental.pallas as pl  # local import keeps module cpu-safe
@@ -153,7 +154,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[0].astype(jnp.float32)                 # (block_k, D)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            # bottom-right aligned (q_offset = s_k - s_q), matching
+            # mha_reference's tril(k=s_k-s_q), _lse_pass and _flash_bwd —
+            # the fwd/bwd pair must mask identically or causal s_q != s_k
+            # gradients would be silently wrong (round-3 advisor finding).
+            q_pos = (q_offset + q_start +
+                     lax.broadcasted_iota(jnp.int32, s.shape, 0))
             k_pos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_prev = m_ref[:, :1]                            # (block_q, 1)
@@ -169,7 +175,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     if causal:
         # Skip fully-masked tiles: every q in the tile is before every k.
-        pl.when(q_start + block_q - 1 >= k_start)(_compute)
+        pl.when(q_offset + q_start + block_q - 1 >= k_start)(_compute)
     else:
         _compute()
 
@@ -198,7 +204,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     grid = (b * h, num_q, num_k)
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-        num_k_blocks=num_k, causal=causal)
+        num_k_blocks=num_k, causal=causal, q_offset=s_k - s_q)
     # Under shard_map (e.g. Ulysses sequence parallelism) the output must
     # declare which mesh axes it varies over. Use the union of the inputs'
     # varying sets and lift any less-varying input up to it so mixed-vma
@@ -379,7 +385,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     bq = fit_block(s_q, min(block_q, s_q))
     bk = fit_block(s_k, min(block_k, s_k))
-    if bq is None or bk is None or (causal and s_q != s_k):
+    # causal s_q < s_k (decode-style) rides the kernel: fwd/bwd both mask
+    # bottom-right aligned. s_q > s_k would leave some q rows with no
+    # visible key (all -inf) — keep those on the reference path.
+    if bq is None or bk is None or (causal and s_q > s_k):
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     block_q, block_k = bq, bk
     if not _on_tpu():

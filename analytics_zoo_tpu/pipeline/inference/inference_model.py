@@ -51,6 +51,11 @@ class InferenceModel:
         self._variables = None
         self._cache: Dict[Tuple, Callable] = {}
         self._lock = threading.Lock()
+        # call_tf-backed loaders set this: jax2tf.call_tf under jit requires
+        # the TF function to be XLA-compilable, which frozen graphs with
+        # NMS/lookup ops (TFNet's main use case) are not — those apply_fns
+        # must run eagerly so TF executes its own kernels host-side.
+        self._eager = False
 
     # --- loaders ------------------------------------------------------------
     def load_jax(self, module, variables) -> "InferenceModel":
@@ -64,6 +69,8 @@ class InferenceModel:
 
         self._apply_fn = apply_fn
         self._variables = jax.device_put(variables)
+        self._eager = False
+        self._cache.clear()
         return self
 
     # --- int8 weight quantization -------------------------------------------
@@ -180,6 +187,7 @@ class InferenceModel:
             donor = net.as_inference_model()
             self._apply_fn = donor._apply_fn
             self._variables = donor._variables
+            self._eager = donor._eager
             self._cache.clear()
             return self
         model = tf.keras.models.load_model(model_path)
@@ -211,6 +219,7 @@ class InferenceModel:
 
             self._apply_fn = apply_fn
             self._variables = {}
+            self._eager = True
             return self
 
     def load_openvino(self, *args, **kwargs):
@@ -232,6 +241,8 @@ class InferenceModel:
 
         self._apply_fn = apply_fn
         self._variables = None
+        self._eager = False
+        self._cache.clear()
         return self
 
     # --- predict ------------------------------------------------------------
@@ -251,11 +262,20 @@ class InferenceModel:
         zero-filled batch of exactly the bucket size through ``predict``,
         warming exactly the cache the serving path uses.
         """
+        if self._eager:
+            # eager (call_tf) models have no jit cache to warm; probing
+            # would run the full TF graph once per bucket for zero benefit
+            return self
         multi = isinstance(example, (list, tuple))
         xs = [np.asarray(a) for a in (example if multi else [example])]
-        for b in self.buckets:
-            if max_bucket is not None and b > max_bucket:
-                break
+        targets = [b for b in self.buckets
+                   if max_bucket is None or b <= max_bucket]
+        if (max_bucket is not None and max_bucket not in self.buckets
+                and max_bucket > self.buckets[-1]):
+            # overflow bucket: _bucket() rounds past the largest configured
+            # bucket to ceil-multiples, so warm that exact size too
+            targets.append(max_bucket)
+        for b in targets:
             probe = [np.zeros((b,) + a.shape[1:], a.dtype) for a in xs]
             self.predict(probe if multi else probe[0])
         return self
@@ -275,17 +295,22 @@ class InferenceModel:
                                     *[a[:1] for a in xs])
             self._variables = jax.device_put(loader(variables))
         n = len(xs[0])
-        b = _bucket(n, self.buckets)
-        padded = [np.concatenate(
-            [a, np.zeros((b - n,) + a.shape[1:], a.dtype)]) if b > n else a
-            for a in xs]
-        key = (b,) + tuple((a.shape[1:], str(a.dtype)) for a in padded)
-        with self._lock:
-            fn = self._cache.get(key)
-            if fn is None:
-                fn = jax.jit(self._apply_fn)
-                self._cache[key] = fn
-        out = fn(self._variables, *padded)
+        if self._eager:
+            # no compilation to amortize — padding would just run the TF
+            # graph on phantom rows
+            out = self._apply_fn(self._variables, *xs)
+        else:
+            b = _bucket(n, self.buckets)
+            padded = [np.concatenate(
+                [a, np.zeros((b - n,) + a.shape[1:], a.dtype)]) if b > n
+                else a for a in xs]
+            key = (b,) + tuple((a.shape[1:], str(a.dtype)) for a in padded)
+            with self._lock:
+                fn = self._cache.get(key)
+                if fn is None:
+                    fn = jax.jit(self._apply_fn)
+                    self._cache[key] = fn
+            out = fn(self._variables, *padded)
         out = jax.device_get(out)
         if isinstance(out, (list, tuple)):
             return type(out)(np.asarray(o)[:n] for o in out)

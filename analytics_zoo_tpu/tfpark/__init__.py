@@ -258,7 +258,12 @@ class TFNet:
 
     def as_inference_model(self):
         """Wrap for ClusterServing / InferenceModel.predict (host-side TF
-        execution via call_tf; see class docstring for the TPU caveat)."""
+        execution via call_tf; see class docstring for the TPU caveat).
+
+        The wrapper runs EAGERLY (``InferenceModel._eager``): call_tf under
+        ``jax.jit`` requires the TF function to be XLA-compilable, and frozen
+        graphs with NMS/lookup ops — TFNet's main use case — are not; eager
+        call_tf lets TF execute its own kernels host-side instead."""
         from ..pipeline.inference import InferenceModel
         from jax.experimental import jax2tf
         fn = self._fn
@@ -274,6 +279,7 @@ class TFNet:
         im = InferenceModel()
         im._apply_fn = apply_fn
         im._variables = {}
+        im._eager = True
         return im
 
 

@@ -61,6 +61,32 @@ def test_blockwise_attention_matches_reference():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_flash_causal_decode_shape_matches_reference():
+    """Causal with s_q < s_k (decode): the kernel masks bottom-right
+    aligned — fwd, _lse_pass and _flash_bwd must all use the same
+    (s_k - s_q) offset (round-3 advisor finding), so both values and
+    gradients must match mha_reference."""
+    q, k, v = _qkv(s=64)
+    qs = q[:, :32]
+    ref = mha_reference(qs, k, v, causal=True)
+    out = flash_attention(qs, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_ref(qs, k, v):
+        return jnp.sum(mha_reference(qs, k, v, causal=True) ** 2)
+
+    def loss_flash(qs, k, v):
+        return jnp.sum(flash_attention(qs, k, v, causal=True,
+                                       block_q=16, block_k=16) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qs, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(qs, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
 def test_flash_block_autofit_stays_on_kernel():
     """Default 512-tiles with a sequence divisible by 128 but not 512:
     fit_block must shrink the tile (kernel path, no O(S^2) materialize)

@@ -90,6 +90,38 @@ def test_cluster_serving_end_to_end(orca_context):
         serving.stop()
 
 
+def test_precompile_covers_rounded_up_bucket(orca_context):
+    """batch_size=48 is not itself a bucket: full batches round up to
+    bucket 64 via _bucket(), so start(example) must warm 64 too —
+    otherwise steady-state full batches pay the first compile the
+    precompile exists to avoid (round-3 advisor finding)."""
+    model = _simple_model()
+    broker = InMemoryBroker()
+    serving = ClusterServing(model, queue=broker, batch_size=48,
+                             batch_timeout_ms=10)
+    serving.start(example=np.zeros((2, 4), np.float32))
+    try:
+        warmed = {key[0] for key in model._cache}
+        assert 64 in warmed, warmed
+    finally:
+        serving.stop()
+
+
+def test_evaluate_map_rejects_original_sizes(orca_context):
+    """evaluate_map scales GT by the model input size; forwarding
+    original_sizes to predict would rescale detections to per-image frames
+    and silently corrupt the mAP — it must be rejected."""
+    import pytest as _pytest
+
+    from analytics_zoo_tpu.models.image.objectdetection import ObjectDetector
+    det = ObjectDetector(class_names=["thing"], image_size=64,
+                         model_type="ssd_tiny")
+    imgs = np.zeros((1, 64, 64, 3), np.float32)
+    with _pytest.raises(ValueError, match="original_sizes"):
+        det.evaluate_map(imgs, [np.zeros((1, 4), np.float32)], [[1]],
+                         original_sizes=[(128, 128)])
+
+
 def test_hot_model_swap(orca_context):
     """update_model swaps the served model without restarting the engine
     (reference rolls a new Flink job; here it's a reference swap)."""
