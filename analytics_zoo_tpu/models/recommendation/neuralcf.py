@@ -6,6 +6,14 @@ embeddings, optional GMF branch multiplied elementwise, softmax head with
 ``class_num`` classes), re-expressed as a flax module whose embeddings and
 matmuls land on the MXU. Inputs are int32 ``(batch, 2)`` [user, item] pairs —
 the same packed layout the reference feeds (Select(1,0)/Select(1,1)).
+
+TPU embedding path (round-4 perf work, scripts/ncf_probe.py): the MLP and
+GMF tables for each side are FUSED into one ``(count+1, mlp+mf)`` table so a
+sample costs two 128-lane gathers instead of four, and lookups go through
+:func:`~analytics_zoo_tpu.ops.embedding.embedding_lookup`, whose backward
+computes the table gradient as a one-hot matmul on the MXU instead of XLA's
+serialized scatter-add. Measured on a v5e chip at batch 512k this is the
+difference between 13.9M and 20.3M samples/sec/chip.
 """
 
 from __future__ import annotations
@@ -15,6 +23,7 @@ from typing import Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ...ops.embedding import embedding_lookup
 from ..common.zoo_model import ZooModel
 
 
@@ -29,27 +38,31 @@ class NeuralCFNet(nn.Module):
     mf_embed: int = 20
     compute_dtype: jnp.dtype = jnp.float32
     return_logits: bool = False
+    embed_grad_mode: str = "auto"    # see ops.embedding.embedding_lookup
 
     @nn.compact
     def __call__(self, user_item: jnp.ndarray) -> jnp.ndarray:
         ui = user_item.reshape(user_item.shape[0], 2).astype(jnp.int32)
         user, item = ui[:, 0], ui[:, 1]
         init = nn.initializers.uniform(scale=0.04)
-        mlp_u = nn.Embed(self.user_count + 1, self.user_embed,
-                         embedding_init=init, name="mlp_user_embed")(user)
-        mlp_i = nn.Embed(self.item_count + 1, self.item_embed,
-                         embedding_init=init, name="mlp_item_embed")(item)
-        h = jnp.concatenate([mlp_u, mlp_i], -1).astype(self.compute_dtype)
+        mf = self.mf_embed if self.include_mf else 0
+        # one fused (mlp | mf) table per side: [:, :user_embed] feeds the MLP
+        # tower, [:, user_embed:] the GMF branch — halves the gather count
+        u_tbl = self.param("user_embed_table", init,
+                           (self.user_count + 1, self.user_embed + mf))
+        i_tbl = self.param("item_embed_table", init,
+                           (self.item_count + 1, self.item_embed + mf))
+        u = embedding_lookup(u_tbl, user, grad_mode=self.embed_grad_mode)
+        i = embedding_lookup(i_tbl, item, grad_mode=self.embed_grad_mode)
+        h = jnp.concatenate([u[:, :self.user_embed],
+                             i[:, :self.item_embed]],
+                            -1).astype(self.compute_dtype)
         for k, units in enumerate(self.hidden_layers):
             h = nn.relu(nn.Dense(units, dtype=self.compute_dtype,
                                  name=f"mlp_dense_{k}")(h))
         if self.include_mf:
-            mf_u = nn.Embed(self.user_count + 1, self.mf_embed,
-                            embedding_init=init, name="mf_user_embed")(user)
-            mf_i = nn.Embed(self.item_count + 1, self.mf_embed,
-                            embedding_init=init, name="mf_item_embed")(item)
-            h = jnp.concatenate(
-                [h, (mf_u * mf_i).astype(self.compute_dtype)], -1)
+            gmf = u[:, self.user_embed:] * i[:, self.item_embed:]
+            h = jnp.concatenate([h, gmf.astype(self.compute_dtype)], -1)
         logits = nn.Dense(self.class_num, dtype=jnp.float32,
                           name="head")(h)
         return logits if self.return_logits else nn.softmax(logits)

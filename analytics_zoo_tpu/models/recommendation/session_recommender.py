@@ -9,6 +9,8 @@ from typing import Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from ...ops.embedding import MXUEmbed
 import numpy as np
 
 from ..common.zoo_model import ZooModel
@@ -29,7 +31,7 @@ class SessionRecommenderNet(nn.Module):
         (batch, session_length + history_length)."""
         ids = x.astype(jnp.int32)
         sess = ids[:, :self.session_length]
-        emb = nn.Embed(self.item_count + 1, self.item_embed,
+        emb = MXUEmbed(self.item_count + 1, self.item_embed,
                        name="item_embedding")(jnp.clip(sess, 0,
                                                        self.item_count))
         h = emb
@@ -40,7 +42,7 @@ class SessionRecommenderNet(nn.Module):
         if self.include_history:
             hist = ids[:, self.session_length:
                        self.session_length + self.history_length]
-            hemb = nn.Embed(self.item_count + 1, self.item_embed,
+            hemb = MXUEmbed(self.item_count + 1, self.item_embed,
                             name="hist_embedding")(
                 jnp.clip(hist, 0, self.item_count))
             hmean = jnp.mean(hemb, axis=1)

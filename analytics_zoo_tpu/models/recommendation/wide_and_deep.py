@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.embedding import MXUEmbed
 from ..common.zoo_model import ZooModel
 
 
@@ -84,7 +85,7 @@ class WideAndDeepNet(nn.Module):
             for i, (in_dim, out_dim) in enumerate(
                     zip(self.embed_in_dims, self.embed_out_dims)):
                 ids = embed_ids[:, i].astype(jnp.int32)
-                emb = nn.Embed(in_dim + 1, out_dim,
+                emb = MXUEmbed(in_dim + 1, out_dim,
                                name=f"embed_{i}")(jnp.clip(ids, 0, in_dim))
                 parts.append(emb)
             if self.continuous_count:

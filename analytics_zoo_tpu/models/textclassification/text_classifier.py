@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.embedding import MXUEmbed
 from ..common.zoo_model import ZooModel
 
 
@@ -34,7 +35,7 @@ class TextClassifierNet(nn.Module):
                                mat.shape)
             x = jax.lax.stop_gradient(table)[ids]
         else:
-            x = nn.Embed(self.vocab_size, self.embed_dim,
+            x = MXUEmbed(self.vocab_size, self.embed_dim,
                          name="embedding")(ids)
         enc = self.encoder.lower()
         if enc == "cnn":

@@ -1,0 +1,5 @@
+"""TPU compute ops: flash/ring attention kernels, MXU embedding lookup."""
+
+from .embedding import embedding_lookup
+
+__all__ = ["embedding_lookup"]

@@ -1,0 +1,112 @@
+"""MXU-friendly embedding lookup.
+
+XLA's ``scatter-add`` — the default backward of an embedding gather — is a
+serialized op on TPU and dominates the train step of gather-heavy models
+(NCF: round-3 bench showed 0.556x the per-chip baseline with the model
+embedding-bound). For small-to-medium vocabularies the table gradient can
+instead be computed as a one-hot matmul,
+
+    dTable = onehot(ids)^T @ dEmb        # (rows, batch) @ (batch, cols)
+
+which rides the MXU: measured on a v5e chip at batch 512k over the
+MovieLens-sized NCF tables this moves the full train step from 13.9M to
+20.3M samples/sec/chip (scripts/ncf_probe.py; sorted-scatter and plain
+scatter variants both lose). The one-hot is generated inside the fused
+matmul by XLA, in bf16, with f32 accumulation, so the extra HBM cost is nil
+and the FLOP cost is 2*B*rows*cols — worth it while ``rows`` is small, which
+is the regime recommendation/tabular vocabularies live in. Above
+``onehot_rows_max`` the FLOP bill overtakes the scatter serialization and
+the default backward is kept.
+
+Precision: the backward rounds incoming cotangents to bf16 before the
+matmul (an f32 one-hot matmul forfeits the MXU rate and the entire win);
+accumulation is f32, so table grads agree with scatter-add to bf16
+precision (~0.4% relative). That is well inside SGD/Adam gradient-noise
+tolerance — the NCF convergence gate (tests/test_estimator.py) trains
+through this path — but if exact f32 gradients matter, pass
+``grad_mode="scatter"``.
+
+Reference parity: this backs the embedding layers of the model zoo
+(reference NeuralCF/WideAndDeep embed via BigDL ``LookupTable``,
+pyzoo/zoo/models/recommendation/neuralcf.py:30-99).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Crossover heuristic: per-row matmul cost is 2*B*cols FLOPs; scatter cost is
+# per-row serialization. On v5e the matmul wins by >2x at 6k rows and is
+# still ahead at 32k for embed widths <= 256; beyond that the FLOP bill
+# (linear in rows) takes over.
+ONEHOT_ROWS_MAX = 32768
+
+
+@functools.lru_cache(maxsize=None)
+def _make_onehot_lookup(rows: int, dtype_name: str):
+    """custom_vjp lookup specialized per (rows, table dtype): both must be
+    static — rows feeds one_hot's num_classes, dtype the cotangent cast."""
+    dtype = jnp.dtype(dtype_name)
+
+    @jax.custom_vjp
+    def lookup(table, ids):
+        return jnp.take(table, ids, axis=0)
+
+    def fwd(table, ids):
+        return jnp.take(table, ids, axis=0), ids
+
+    def bwd(ids, g):
+        flat_ids = ids.reshape(-1)
+        flat_g = g.reshape(-1, g.shape[-1]).astype(jnp.bfloat16)
+        onehot = jax.nn.one_hot(flat_ids, rows, dtype=jnp.bfloat16)
+        dtable = jax.lax.dot_general(
+            onehot, flat_g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dtable.astype(dtype), None
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array, *,
+                     grad_mode: str = "auto",
+                     onehot_rows_max: int = ONEHOT_ROWS_MAX) -> jax.Array:
+    """``table[ids]`` with a TPU-tuned backward.
+
+    grad_mode:
+      * ``"auto"``    — one-hot-matmul backward while ``table.shape[0] <=
+        onehot_rows_max``, else XLA's scatter-add (large vocabularies).
+      * ``"onehot"``  — always the matmul backward.
+      * ``"scatter"`` — always the default scatter-add backward.
+    """
+    if grad_mode not in ("auto", "onehot", "scatter"):
+        raise ValueError(f"unknown grad_mode {grad_mode!r}")
+    use_onehot = (grad_mode == "onehot" or
+                  (grad_mode == "auto" and table.shape[0] <= onehot_rows_max))
+    if use_onehot:
+        return _make_onehot_lookup(table.shape[0],
+                                   jnp.dtype(table.dtype).name)(table, ids)
+    return jnp.take(table, ids, axis=0)
+
+
+class MXUEmbed(nn.Module):
+    """Drop-in ``nn.Embed`` with the TPU-tuned backward of
+    :func:`embedding_lookup`. The parameter is named ``embedding`` so
+    checkpoints are interchangeable with ``nn.Embed``."""
+
+    num_embeddings: int
+    features: int
+    embedding_init: object = None
+    grad_mode: str = "auto"
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        init = self.embedding_init or nn.initializers.variance_scaling(
+            1.0, "fan_in", "normal", out_axis=0)
+        table = self.param("embedding", init,
+                           (self.num_embeddings, self.features))
+        return embedding_lookup(table, ids, grad_mode=self.grad_mode)

@@ -213,13 +213,15 @@ class InferenceModel:
                 "with supported layers for a native TPU path.",
                 type(e).__name__, e)
             from jax.experimental import jax2tf
+            cfn = jax2tf.call_tf(model)     # once — apply_fn runs per request
 
             def apply_fn(variables, *x):
-                return jax2tf.call_tf(model)(x[0] if len(x) == 1 else list(x))
+                return cfn(x[0] if len(x) == 1 else list(x))
 
             self._apply_fn = apply_fn
             self._variables = {}
             self._eager = True
+            self._cache.clear()
             return self
 
     def load_openvino(self, *args, **kwargs):
@@ -268,13 +270,16 @@ class InferenceModel:
             return self
         multi = isinstance(example, (list, tuple))
         xs = [np.asarray(a) for a in (example if multi else [example])]
-        targets = [b for b in self.buckets
-                   if max_bucket is None or b <= max_bucket]
-        if (max_bucket is not None and max_bucket not in self.buckets
-                and max_bucket > self.buckets[-1]):
-            # overflow bucket: _bucket() rounds past the largest configured
-            # bucket to ceil-multiples, so warm that exact size too
-            targets.append(max_bucket)
+        if max_bucket is None:
+            targets = list(self.buckets)
+        else:
+            # max_bucket is a batch size: warm every bucket a batch of up
+            # to that size can land in, including the rounded-up one
+            # (predict pads partial batches UP via _bucket)
+            top = _bucket(max_bucket, self.buckets)
+            targets = [b for b in self.buckets if b <= top]
+            if top not in targets:
+                targets.append(top)
         for b in targets:
             probe = [np.zeros((b,) + a.shape[1:], a.dtype) for a in xs]
             self.predict(probe if multi else probe[0])

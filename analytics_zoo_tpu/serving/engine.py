@@ -133,14 +133,9 @@ class ClusterServing:
         batches hit cold buckets and compiles land in the latency tail."""
         if example is not None:
             with self.timer.time("precompile"):
-                # steady-state full batches round UP to the smallest bucket
-                # >= batch_size (_bucket in inference_model.py), so warm
-                # through that bucket — stopping at batch_size itself would
-                # leave the one bucket full batches actually hit cold
-                from ..pipeline.inference.inference_model import _bucket
-                self.model.precompile(
-                    example,
-                    max_bucket=_bucket(self.batch_size, self.model.buckets))
+                # precompile rounds batch_size up to the bucket steady-state
+                # full batches actually land in
+                self.model.precompile(example, max_bucket=self.batch_size)
         for i in range(self.num_workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"serving-worker-{i}")

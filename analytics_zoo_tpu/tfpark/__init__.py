@@ -266,10 +266,10 @@ class TFNet:
         call_tf lets TF execute its own kernels host-side instead."""
         from ..pipeline.inference import InferenceModel
         from jax.experimental import jax2tf
-        fn = self._fn
+        cfn = jax2tf.call_tf(self._fn)      # once — apply_fn runs per request
 
         def apply_fn(variables, *x):
-            out = jax2tf.call_tf(fn)(*x)
+            out = cfn(*x)
             # pruned concrete functions return a list of fetches; a single
             # output unwraps so predict() returns the array itself
             if isinstance(out, (list, tuple)) and len(out) == 1:

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""NCF embedding-path probe (round-4 perf investigation).
+
+Measures the scanned (dispatch-free) NCF train step on the real chip across
+model variants, interleaved best-of-N so shared-chip contention can't bias a
+variant. Variants isolate where the step time goes and test the candidate
+optimizations from VERDICT round 3:
+
+  base        round-3 production model (bf16 compute, f32 embedding tables,
+              4 separate gathers)
+  mlp_only    embeddings replaced by slicing a precomputed dense activation
+              (ablation: everything EXCEPT the embedding path)
+  fwd_only    stop_gradient on embedding lookups (ablation: removes the
+              backward scatter-add; isolates scatter cost)
+  bf16_emb    tables stored bf16 (halves gather/scatter HBM bytes)
+  fused       one user table (user_embed+mf_embed wide) + one item table:
+              2 gathers instead of 4, 128-lane rows
+  fused_bf16  fused + bf16 tables
+  onehot_bwd  gather forward, one-hot matmul backward for table grads
+              (custom_vjp: dTable = onehot(ids)^T @ dEmb rides the MXU
+              instead of XLA's serialized scatter-add)
+  fused_onehot  fused + bf16 + onehot backward
+
+Usage: python scripts/ncf_probe.py [--batch 16384] [--steps 50] [--rounds 5]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N_USERS, N_ITEMS = 6040, 3706
+HIDDEN = (128, 64, 32)
+EMB = 64
+CLASSES = 5
+
+
+def build_variant(name, batch):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    f32, bf16 = jnp.float32, jnp.bfloat16
+    rng = np.random.RandomState(0)
+
+    def table(rows, cols, dtype=f32):
+        return jnp.asarray(
+            rng.uniform(-0.04, 0.04, (rows, cols)).astype(np.float32),
+            dtype=dtype)
+
+    def dense_p(fin, fout):
+        w = jnp.asarray((rng.randn(fin, fout) / np.sqrt(fin))
+                        .astype(np.float32))
+        return {"w": w, "b": jnp.zeros((fout,), f32)}
+
+    emb_dtype = bf16 if name in ("bf16_emb", "fused_bf16",
+                                 "fused_onehot", "fused_sorted") else f32
+    fused = name.startswith("fused")
+    onehot_bwd = name in ("onehot_bwd", "fused_onehot")
+    sorted_bwd = name in ("sorted_scatter", "fused_sorted")
+
+    params = {}
+    if fused:
+        params["user_tbl"] = table(N_USERS + 1, 2 * EMB, emb_dtype)
+        params["item_tbl"] = table(N_ITEMS + 1, 2 * EMB, emb_dtype)
+    else:
+        params["mlp_user"] = table(N_USERS + 1, EMB, emb_dtype)
+        params["mlp_item"] = table(N_ITEMS + 1, EMB, emb_dtype)
+        params["mf_user"] = table(N_USERS + 1, EMB, emb_dtype)
+        params["mf_item"] = table(N_ITEMS + 1, EMB, emb_dtype)
+    dims = [2 * EMB] + list(HIDDEN)
+    for k in range(len(HIDDEN)):
+        params[f"mlp_{k}"] = dense_p(dims[k], dims[k + 1])
+    params["head"] = dense_p(HIDDEN[-1] + EMB, CLASSES)
+    if name == "mlp_only":
+        params["fake_act"] = jnp.asarray(
+            rng.randn(batch, 3 * EMB).astype(np.float32), dtype=bf16)
+
+    def lookup(tbl, ids):
+        """Gather fwd; optionally one-hot-matmul or sorted-scatter bwd for
+        the table grad."""
+        if not (onehot_bwd or sorted_bwd):
+            return tbl[ids]
+
+        @jax.custom_vjp
+        def _lk(tbl, ids):
+            return tbl[ids]
+
+        def _fwd(tbl, ids):
+            return tbl[ids], ids
+
+        def _bwd_onehot(ids, g):
+            # dTable = onehot(ids)^T @ g : a (rows x batch)@(batch x cols)
+            # matmul on the MXU instead of a serialized scatter-add
+            oh = jax.nn.one_hot(ids, tbl.shape[0], dtype=g.dtype)
+            return (jnp.einsum("br,bc->rc", oh, g), None)
+
+        def _bwd_sorted(ids, g):
+            order = jnp.argsort(ids)
+            dt = jnp.zeros(tbl.shape, g.dtype).at[ids[order]].add(
+                g[order], indices_are_sorted=True)
+            return (dt, None)
+
+        _lk.defvjp(_fwd, _bwd_sorted if sorted_bwd else _bwd_onehot)
+        return _lk(tbl, ids)
+
+    def forward(params, ui):
+        user, item = ui[:, 0], ui[:, 1]
+        if name == "mlp_only":
+            act = params["fake_act"]
+            h, mf = act[:, :2 * EMB], act[:, 2 * EMB:]
+        elif fused:
+            u = lookup(params["user_tbl"], user).astype(bf16)
+            i = lookup(params["item_tbl"], item).astype(bf16)
+            h = jnp.concatenate([u[:, :EMB], i[:, :EMB]], -1)
+            mf = u[:, EMB:] * i[:, EMB:]
+        else:
+            mu = lookup(params["mlp_user"], user)
+            mi = lookup(params["mlp_item"], item)
+            if name == "fwd_only":
+                mu, mi = jax.lax.stop_gradient((mu, mi))
+            h = jnp.concatenate([mu, mi], -1).astype(bf16)
+            fu = lookup(params["mf_user"], user)
+            fi = lookup(params["mf_item"], item)
+            if name == "fwd_only":
+                fu, fi = jax.lax.stop_gradient((fu, fi))
+            mf = (fu * fi).astype(bf16)
+        for k in range(len(HIDDEN)):
+            p = params[f"mlp_{k}"]
+            h = jax.nn.relu(h @ p["w"].astype(bf16) + p["b"].astype(bf16))
+        h = jnp.concatenate([h, mf], -1)
+        p = params["head"]
+        return (h.astype(f32) @ p["w"] + p["b"])
+
+    def loss_fn(params, ui, y):
+        logits = forward(params, ui)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def multi(params, opt_state, ui, y, steps):
+        def body(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, ui, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=steps)
+        return params, opt_state, losses[-1]
+
+    return params, opt_state, multi
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--variants", type=str, default="")
+    args = ap.parse_args()
+
+    import jax
+
+    rng = np.random.RandomState(1)
+    ui = jax.device_put(np.stack(
+        [rng.randint(1, N_USERS, args.batch),
+         rng.randint(1, N_ITEMS, args.batch)], -1).astype(np.int32))
+    y = jax.device_put(rng.randint(0, CLASSES, args.batch).astype(np.int32))
+
+    names = (args.variants.split(",") if args.variants else
+             ["base", "mlp_only", "fwd_only", "bf16_emb", "fused",
+              "fused_bf16", "onehot_bwd", "fused_onehot"])
+    runs = {}
+    for n in names:
+        p, o, fn = build_variant(n, args.batch)
+        p, o, l = fn(p, o, ui, y, args.steps)   # compile + warm
+        float(l)
+        runs[n] = {"params": p, "opt": o, "fn": fn, "best": float("inf")}
+
+    for r in range(args.rounds):               # interleaved best-of-N
+        for n in names:
+            st = runs[n]
+            t0 = time.perf_counter()
+            p, o, l = st["fn"](st["params"], st["opt"], ui, y, args.steps)
+            float(l)
+            dt = (time.perf_counter() - t0) / args.steps
+            st["params"], st["opt"] = p, o
+            st["best"] = min(st["best"], dt)
+
+    out = {}
+    for n in names:
+        dt = runs[n]["best"]
+        out[n] = {"us_per_step": round(dt * 1e6, 1),
+                  "samples_per_sec": round(args.batch / dt, 0)}
+    print(json.dumps({"batch": args.batch, "steps": args.steps,
+                      "variants": out}, indent=2))
+
+
+if __name__ == "__main__":
+    main()
