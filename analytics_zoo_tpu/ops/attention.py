@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+LOG2_E = 1.4426950408889634      # the flash kernel softmaxes in base 2
 
 
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -130,13 +131,18 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
 # Pallas TPU flash-attention kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  sm_scale, block_q, block_k, num_k_blocks, causal,
-                  q_offset=0):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q,
+                  block_k, num_k_blocks, causal, q_offset=0, with_lse=False):
     """Grid = (batch*heads, num_q_blocks, num_k_blocks); the k dim is innermost
-    so (acc, m, l) scratch carries the online softmax across k iterations."""
+    so (acc, m, l) scratch carries the online softmax across k iterations.
+    With ``with_lse`` the kernel also emits the log2-domain logsumexp
+    (m + log2 l) per q row, which the Pallas backward consumes."""
     import jax.experimental.pallas as pl  # local import keeps module cpu-safe
 
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
 
@@ -149,11 +155,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     q_start = q_idx * block_q
     k_start = k_idx * block_k
 
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)                 # (block_q, D)
-        k = k_ref[0].astype(jnp.float32)                 # (block_k, D)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+    def _compute(masked):
+        # matmuls keep the input dtype (bf16 inputs hit the MXU at full
+        # rate) with f32 accumulation; softmax state is always f32.
+        # q arrives PRE-SCALED by sm_scale*log2(e) (_flash_forward), so the
+        # scores are already in the log2 domain: one fewer (block_q,
+        # block_k) multiply per tile, and exp2 instead of exp — at D=64
+        # the kernel is VPU-bound on exactly these elementwise passes.
+        q = q_ref[0]                                     # (block_q, D)
+        k = k_ref[0]                                     # (block_k, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if masked:
             # bottom-right aligned (q_offset = s_k - s_q), matching
             # mha_reference's tril(k=s_k-s_q), _lse_pass and _flash_bwd —
             # the fwd/bwd pair must mask identically or causal s_q != s_k
@@ -164,35 +178,63 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_prev = m_ref[:, :1]                            # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                           # (block_q, block_k)
-        correction = jnp.exp(m_prev - m_new)             # (block_q, 1)
+        p = jnp.exp2(s - m_new)                          # (block_q, block_k)
+        correction = jnp.exp2(m_prev - m_new)            # (block_q, 1)
         l_ref[...] = (l_ref[...] * correction +
                       jnp.sum(p, axis=-1, keepdims=True))
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        v = v_ref[0].astype(jnp.float32)
+        v = v_ref[0]
         acc_ref[...] = (acc_ref[...] * correction +
-                        jnp.dot(p, v, preferred_element_type=jnp.float32))
+                        jnp.dot(p.astype(v.dtype), v,
+                                preferred_element_type=jnp.float32))
 
     if causal:
-        # Skip fully-masked tiles: every q in the tile is before every k.
-        pl.when(q_offset + q_start + block_q - 1 >= k_start)(_compute)
+        # Three tile classes: fully masked (skip), diagonal (mask), and
+        # interior (q_pos >= k_pos everywhere — no mask work: the two
+        # iotas + compare + select are (block_q, block_k) VPU passes that
+        # would otherwise run on every tile of a VPU-bound kernel).
+        active = q_offset + q_start + block_q - 1 >= k_start
+        diagonal = q_offset + q_start < k_start + block_k - 1
+        pl.when(active & diagonal)(lambda: _compute(True))
+        pl.when(active & jnp.logical_not(diagonal))(lambda: _compute(False))
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(k_idx == num_k_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        if with_lse:
+            # p_ij = exp2(s2_ij - L2_i) with L2 = m + log2 l (log2 domain)
+            lse_ref[0] = m_ref[:, :1] + jnp.log2(l)
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _input_vma(arrays):
+    """Union of the operands' shard_map varying sets (see _flash_forward)."""
+    vma = frozenset()
+    for a in arrays:
+        vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+    return vma
+
+
+def _lift_vma(arrays, vma):
+    return [jax.lax.pvary(
+        a, tuple(vma - (getattr(jax.typeof(a), "vma", None) or frozenset())))
+        for a in arrays]
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                   with_lse=False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     # (B, S, H, D) -> (B*H, S, D): each grid row owns one head's sequence.
-    qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
+    # q is pre-scaled into the log2 domain for the kernel's exp2 softmax
+    # (see _flash_kernel); one multiply here replaces one per k-tile.
+    qf = (q * jnp.asarray(sm_scale * LOG2_E, q.dtype))
+    qf = jnp.moveaxis(qf, 2, 1).reshape(b * h, s_q, d)
     kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
     vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
 
@@ -203,20 +245,25 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
     grid = (b * h, num_q, num_k)
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-        num_k_blocks=num_k, causal=causal, q_offset=s_k - s_q)
+        _flash_kernel, block_q=block_q, block_k=block_k,
+        num_k_blocks=num_k, causal=causal, q_offset=s_k - s_q,
+        with_lse=with_lse)
     # Under shard_map (e.g. Ulysses sequence parallelism) the output must
     # declare which mesh axes it varies over. Use the union of the inputs'
     # varying sets and lift any less-varying input up to it so mixed-vma
     # call sites (e.g. cross-attention with replicated q) still compile.
-    vma = frozenset()
-    for a in (qf, kf, vf):
-        vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+    vma = _input_vma((qf, kf, vf))
     if vma:
-        qf, kf, vf = (jax.lax.pvary(
-            a, tuple(vma - (getattr(jax.typeof(a), "vma", None) or
-                            frozenset()))) for a in (qf, kf, vf))
-    out = pl.pallas_call(
+        qf, kf, vf = _lift_vma((qf, kf, vf), vma)
+    out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype, vma=vma)]
+    out_specs = [pl.BlockSpec((1, block_q, d),
+                              lambda bh, qi, ki: (bh, qi, 0))]
+    if with_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma))
+        out_specs.append(pl.BlockSpec((1, block_q, 1),
+                                      lambda bh, qi, ki: (bh, qi, 0)))
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -224,8 +271,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype, vma=vma),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -233,7 +280,10 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
+    out = jnp.moveaxis(res[0].reshape(b, h, s_q, d), 1, 2)
+    if with_lse:
+        return out, res[1]
+    return out
 
 
 def _on_tpu() -> bool:
@@ -251,111 +301,210 @@ def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out = _flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v, out)
+    interpret = not _on_tpu()
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                              interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
-def _lse_pass(qf, kf, causal, sm_scale, block_k, q_pos):
-    """Recompute the forward logsumexp (b, h, s_q) with an online scan over
-    K blocks — carries only (m, l), never an output accumulator. One of the
-    two forward matmuls; cheaper than saving L through the Pallas kernel
-    (a lane-padded L output would cost s_q x 128 f32 per head in HBM)."""
-    b, s_q, h, d = qf.shape
-    s_k = kf.shape[1]
-    nk = s_k // block_k
-    k_blocks = jnp.moveaxis(kf.reshape(b, nk, block_k, h, d), 1, 0)
-    starts = jnp.arange(nk) * block_k
+def _bwd_tile(masked, q2, k, v, g, L, D, q_offset, q_start, k_start, cd):
+    """Shared (block_q, block_k) backward tile: rebuild P from (q2, k, L),
+    then ds = P*(dP - D). All matmuls keep the input dtype (bf16 rides the
+    MXU) with f32 accumulation; returns (p, ds) in compute dtype ``cd``."""
+    s2 = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if masked:
+        q_pos = (q_offset + q_start +
+                 lax.broadcasted_iota(jnp.int32, s2.shape, 0))
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, s2.shape, 1)
+        s2 = jnp.where(q_pos >= k_pos, s2, NEG_INF)
+    p = jnp.exp2(s2 - L)                             # true softmax probs
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - D)).astype(cd)
+    return p.astype(cd), ds
 
-    def step(carry, inputs):
-        m, l = carry
-        k_blk, k0 = inputs
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
-                       preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            k_pos = k0 + jnp.arange(block_k)
-            s = jnp.where(q_pos[None, None, :, None] >=
-                          k_pos[None, None, None, :], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(s - m_new[..., None]), axis=-1)
-        return (m_new, l), None
 
-    init = (jnp.full((b, h, s_q), NEG_INF, jnp.float32),
-            jnp.zeros((b, h, s_q), jnp.float32))
-    (m, l), _ = lax.scan(step, init, (k_blocks, starts))
-    return m + jnp.log(jnp.maximum(l, 1e-30))
+def _flash_bwd_dq_kernel(q2_ref, k_ref, v_ref, g_ref, L_ref, D_ref, dq_ref,
+                         acc_ref, *, sm_scale, block_q, block_k,
+                         num_k_blocks, causal, q_offset, cd):
+    """dQ pass: grid (batch*heads, num_q, num_k), k innermost; the dq tile
+    accumulates across k iterations in VMEM scratch — no (S, S) tensor
+    ever reaches HBM (the round-3 pure-JAX backward streamed every P/dS
+    tile through HBM between the dot_generals, which bounded fwd+bwd at
+    ~1.4x materialized; tiles resident in VMEM are the FA-2 design)."""
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = q_idx * block_q
+    k_start = k_idx * block_k
+
+    def _compute(masked):
+        _, ds = _bwd_tile(masked, q2_ref[0], k_ref[0], v_ref[0],
+                          g_ref[0], L_ref[0], D_ref[0], q_offset, q_start,
+                          k_start, cd)
+        acc_ref[...] += jnp.dot(ds, k_ref[0],
+                                preferred_element_type=jnp.float32)
+
+    if causal:
+        active = q_offset + q_start + block_q - 1 >= k_start
+        diagonal = q_offset + q_start < k_start + block_k - 1
+        pl.when(active & diagonal)(lambda: _compute(True))
+        pl.when(active & jnp.logical_not(diagonal))(lambda: _compute(False))
+    else:
+        _compute(False)
+
+    @pl.when(k_idx == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q2_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q,
+                          block_k, num_q_blocks, causal, q_offset, cd):
+    """dK/dV pass: grid (batch*heads, num_k, num_q), q innermost; both
+    accumulators live in VMEM scratch. dv += P^T g and dk += dS^T q2 are
+    expressed as dot_generals contracting the q (sublane) dim. q2 is the
+    log2-prescaled q, so dk carries a 1/log2(e) correction at finalize."""
+    import jax.experimental.pallas as pl
+
+    k_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = q_idx * block_q
+    k_start = k_idx * block_k
+
+    def _compute(masked):
+        g = g_ref[0]
+        p, ds = _bwd_tile(masked, q2_ref[0], k_ref[0], v_ref[0],
+                          g, L_ref[0], D_ref[0], q_offset, q_start,
+                          k_start, cd)
+        dv_acc[...] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q2_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        active = q_offset + q_start + block_q - 1 >= k_start
+        diagonal = q_offset + q_start < k_start + block_k - 1
+        pl.when(active & diagonal)(lambda: _compute(True))
+        pl.when(active & jnp.logical_not(diagonal))(lambda: _compute(False))
+    else:
+        _compute(False)
+
+    @pl.when(q_idx == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[...] * (1.0 / LOG2_E)).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    """FlashAttention-2-style tiled backward in pure JAX: recompute the
-    logsumexp, then one (q-block x k-block) double scan that rebuilds each
-    P tile from (q, k, L) and accumulates dq/dk/dv — peak residual memory
-    is O(S*D) carries plus one (block_q, block_k) tile per (b, h), i.e.
-    truly linear in S (the round-2 backward still carried an (Sq, D)
-    accumulator per K block through the differentiated scan)."""
-    q, k, v, o = res
+    """FlashAttention-2-style Pallas backward: a dQ kernel (k innermost)
+    and a dK/dV kernel (q innermost), both consuming the forward's
+    log2-domain logsumexp. Every (block_q, block_k) P/dS tile lives and
+    dies in VMEM — the previous pure-JAX backward streamed each of its
+    ~6 (b, h, S, S)-shaped intermediates through HBM between dot_generals
+    (~13 GB per step at S=4096), which bounded fwd+bwd at ~1.4x
+    materialized attention on a v5e chip."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, o, lse = res
+    interpret = not _on_tpu()
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    bq, bk = block_q, block_k
+    bq, bk = min(block_q, s_q), min(block_k, s_k)
+    # the backward keeps ~4 (bq, bk) f32 tiles + operands live per grid
+    # step; 1024x1024 f32 blows the 16M VMEM scoped limit — halve down to
+    # <=512 (divisibility holds: 512 divides anything 1024+ blocks divide)
+    while bq > 512:
+        bq //= 2
+    while bk > 512:
+        bk //= 2
     nq, nk = s_q // bq, s_k // bk
-    f32 = jnp.float32
-    qf, kf, vf, gf, of = (a.astype(f32) for a in (q, k, v, g, o))
-    q_pos = jnp.arange(s_q) + (s_k - s_q)     # bottom-right aligned causal
+    bh = b * h
+    cd = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
 
-    L = _lse_pass(qf, kf, causal, sm_scale, bk, q_pos)     # (b, h, s_q)
-    Dvec = jnp.sum(gf * of, axis=-1)                       # (b, s_q, h)
-    Dvec = jnp.moveaxis(Dvec, -1, 1)                       # (b, h, s_q)
+    def flat(a):                                 # (B,S,H,D) -> (B*H,S,D)
+        return jnp.moveaxis(a, 2, 1).reshape(bh, a.shape[1], d)
 
-    def qsplit(a):      # (b, s_q, ...) -> (nq, b, bq, ...)
-        return jnp.moveaxis(a.reshape(b, nq, bq, *a.shape[2:]), 1, 0)
+    q2 = flat(q * jnp.asarray(sm_scale * LOG2_E, q.dtype))
+    kf, vf, gf, of = flat(k), flat(v), flat(g.astype(q.dtype)), flat(o)
+    # D_i = sum_d g*o — one elementwise pass; (bh, s_q, 1) so the kernels
+    # load it sublane-oriented (per-q-row, broadcast along k lanes)
+    D = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                axis=-1, keepdims=True)
 
-    def ksplit(a):
-        return jnp.moveaxis(a.reshape(b, nk, bk, *a.shape[2:]), 1, 0)
+    vma = _input_vma((q2, kf, vf, gf, lse, D))
+    if vma:
+        q2, kf, vf, gf, lse, D = _lift_vma((q2, kf, vf, gf, lse, D), vma)
 
-    q_blocks, g_blocks = qsplit(qf), qsplit(gf)            # (nq,b,bq,h,d)
-    L_blocks = jnp.moveaxis(L.reshape(b, h, nq, bq), 2, 0)  # (nq,b,h,bq)
-    D_blocks = jnp.moveaxis(Dvec.reshape(b, h, nq, bq), 2, 0)
-    k_blocks, v_blocks = ksplit(kf), ksplit(vf)            # (nk,b,bk,h,d)
+    # --- dQ: grid (bh, nq, nk), k innermost --------------------------------
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=sm_scale, block_q=bq, block_k=bk,
+        num_k_blocks=nk, causal=causal, q_offset=s_k - s_q, cd=cd)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype, vma=vma),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q2, kf, vf, gf, lse, D)
 
-    def outer(carry, qin):
-        dk_acc, dv_acc = carry                             # (nk,b,bk,h,d)
-        q_blk, g_blk, L_blk, D_blk, qi = qin
+    # --- dK/dV: grid (bh, nk, nq), q innermost -----------------------------
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=bq, block_k=bk, num_q_blocks=nq,
+        causal=causal, q_offset=s_k - s_q, cd=cd)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype, vma=vma),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q2, kf, vf, gf, lse, D)
 
-        def inner(dq_blk, kin):
-            k_blk, v_blk, ki = kin
-            s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_blk,
-                           preferred_element_type=f32) * sm_scale
-            if causal:
-                qp = (s_k - s_q) + qi * bq + jnp.arange(bq)
-                kp = ki * bk + jnp.arange(bk)
-                s = jnp.where(qp[None, None, :, None] >=
-                              kp[None, None, None, :], s, NEG_INF)
-            p = jnp.exp(s - L_blk[..., None])              # (b,h,bq,bk)
-            dp = jnp.einsum("bqhd,bkhd->bhqk", g_blk, v_blk,
-                            preferred_element_type=f32)
-            ds = p * (dp - D_blk[..., None]) * sm_scale
-            dq_blk = dq_blk + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk,
-                                         preferred_element_type=f32)
-            dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, q_blk,
-                              preferred_element_type=f32)
-            dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, g_blk,
-                              preferred_element_type=f32)
-            return dq_blk, (dk_c, dv_c)
+    def unflat(a, s_len):
+        return jnp.moveaxis(a.reshape(b, h, s_len, d), 1, 2)
 
-        dq_blk, (dk_cs, dv_cs) = lax.scan(
-            inner, jnp.zeros((b, bq, h, d), f32),
-            (k_blocks, v_blocks, jnp.arange(nk)))
-        return (dk_acc + dk_cs, dv_acc + dv_cs), dq_blk
-
-    zeros_kv = jnp.zeros((nk, b, bk, h, d), f32)
-    (dk_s, dv_s), dq_s = lax.scan(
-        outer, (zeros_kv, zeros_kv),
-        (q_blocks, g_blocks, L_blocks, D_blocks, jnp.arange(nq)))
-
-    dq = jnp.moveaxis(dq_s, 0, 1).reshape(b, s_q, h, d).astype(q.dtype)
-    dk = jnp.moveaxis(dk_s, 0, 1).reshape(b, s_k, h, d).astype(k.dtype)
-    dv = jnp.moveaxis(dv_s, 0, 1).reshape(b, s_k, h, d).astype(v.dtype)
-    return dq, dk, dv
+    return unflat(dq, s_q), unflat(dk, s_k), unflat(dv, s_k)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -363,13 +512,16 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512) -> jax.Array:
+                    block_q: int = 1024, block_k: int = 1024) -> jax.Array:
     """Flash attention over (B, S, H, D). Uses the Pallas kernel when the
     sequence tiles evenly (interpret mode off-TPU), else the reference path.
 
-    Default 512x512 tiles: measured ~1.5-1.8x faster than 128x128 on a v5e
-    chip at S=4096/D=64 (bigger tiles amortize the per-tile softmax state
-    and keep the MXU fed); min() below shrinks them for short sequences."""
+    Default 1024x1024 forward tiles: round-4 sweep on a v5e chip at
+    S=4096/D=64-128 measured 1024x1024 fastest of {256..2048}x{512,1024}
+    (bigger tiles amortize the per-tile softmax state and keep the MXU
+    fed; 2048-wide tiles spill VMEM and regress). The backward caps its
+    tiles at 512 internally — its VMEM working set is ~4 score tiles.
+    fit_block below shrinks tiles for short/odd sequences."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
@@ -378,7 +530,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # largest tile <= want that divides the sequence, so raising the
         # default never diverts a divisible-by-128 length off the kernel
         # (materializing O(S^2) scores) just because S % want != 0
-        for cand in (want, 512, 256, 128, 64, 32, 16, 8):
+        for cand in (want, 1024, 512, 256, 128, 64, 32, 16, 8):
             if cand <= want and s % cand == 0:
                 return cand
         return None

@@ -87,15 +87,42 @@ def test_flash_causal_decode_shape_matches_reference():
                                    rtol=2e-3, atol=2e-3)
 
 
-def test_flash_block_autofit_stays_on_kernel():
-    """Default 512-tiles with a sequence divisible by 128 but not 512:
-    fit_block must shrink the tile (kernel path, no O(S^2) materialize)
-    and the numerics must still match the reference. s=640 > 512 and
-    640 % 512 != 0, so only the divisor ladder (640 % 128 == 0) keeps
-    this on the kernel."""
-    q, k, v = _qkv(s=640)
+def test_flash_bf16_matches_reference():
+    """bf16 q/k/v: the kernel keeps matmul operands in bf16 (MXU rate) with
+    f32 accumulation and f32 softmax state — values and grads must agree
+    with the f32 reference to bf16 precision."""
+    q, k, v = _qkv(s=64)
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
     ref = mha_reference(q, k, v, causal=True)
-    out = flash_attention(q, k, v, causal=True)     # default 512x512 tiles
+    out = flash_attention(qb, kb, vb, causal=True, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=3e-2, atol=3e-2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                       block_k=16).astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a),
+                                   rtol=6e-2, atol=6e-2)
+
+
+def test_flash_block_autofit_stays_on_kernel():
+    """Default tiles with a sequence divisible by 128 but by no larger
+    ladder rung: fit_block must shrink the tile (kernel path, no O(S^2)
+    materialize) and the numerics must still match the reference.
+    s=1152 > 1024, 1152 % 1024 != 0, 1152 % 512 != 0, 1152 % 256 != 0,
+    so only the 128 rung of the divisor ladder keeps this on the kernel."""
+    q, k, v = _qkv(s=1152)
+    ref = mha_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)     # default 1024x1024 tiles
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
 
