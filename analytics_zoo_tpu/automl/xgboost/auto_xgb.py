@@ -1,26 +1,43 @@
 """AutoXGBoost (parity: pyzoo/zoo/orca/automl/xgboost/auto_xgb.py —
 AutoXGBRegressor/AutoXGBClassifier over the search engine).
 
-xgboost is not baked into the TPU image; when it is importable these classes
-run real HPO over xgboost models with the same chip-pinned search engine the
-flax models use, otherwise construction raises with install guidance."""
+xgboost is not baked into the TPU image. When it is importable these
+classes run HPO over real xgboost models; otherwise they fall back to the
+bundled histogram GBT engine (hist_gbt.py — same second-order hist
+algorithm family, sklearn-compatible surface), so AutoXGBoost is fully
+executable out of the box either way. Tree training runs on host CPU by
+design; only the trial scheduler (chip-pinned TPUSearchEngine) is shared
+with the flax models."""
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+logger = logging.getLogger("analytics_zoo_tpu")
 
-def _require_xgboost():
+
+from . import hist_gbt
+
+
+class _BuiltinBackend:
+    """xgboost-shaped namespace over the bundled histogram GBT."""
+
+    XGBRegressor = hist_gbt.ZooGBTRegressor
+    XGBClassifier = hist_gbt.ZooGBTClassifier
+
+
+def _backend():
     try:
         import xgboost
         return xgboost
-    except ImportError as e:
-        raise ImportError(
-            "AutoXGBoost needs the 'xgboost' package, which is not part of "
-            "the TPU image. pip install xgboost (CPU training) to use it; "
-            "tree models do not run on the TPU compute path.") from e
+    except ImportError:
+        logger.info(
+            "xgboost not installed — AutoXGBoost using the bundled "
+            "histogram-GBT backend (automl/xgboost/hist_gbt.py)")
+        return _BuiltinBackend
 
 
 class _XGBModelBuilder:
@@ -41,7 +58,7 @@ class _AutoXGB:
     def __init__(self, cpus_per_trial: int = 1, name: str = "auto_xgb",
                  remote_dir: Optional[str] = None, logs_dir: str = "/tmp",
                  **xgb_configs):
-        self.xgb = _require_xgboost()
+        self.xgb = _backend()
         self.fixed = dict(xgb_configs)
         self.name = name
         self.best_model = None

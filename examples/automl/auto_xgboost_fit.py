@@ -6,8 +6,9 @@ pyzoo/zoo/orca/automl/xgboost/auto_xgb.py).
 
 Searches n_estimators/max_depth/lr over chip-pinned trials through
 TPUSearchEngine. If the optional ``xgboost`` package is absent (it is an
-extra, not a core dependency), the same search runs over the AutoEstimator
-MLP fallback so the workflow stays demonstrable end-to-end.
+extra, not a core dependency), AutoXGBRegressor transparently trains the
+bundled histogram-GBT backend (automl/xgboost/hist_gbt.py) — same
+workflow, same search surface, executable out of the box.
 
 Usage:
     python examples/automl/auto_xgboost_fit.py --smoke
@@ -45,47 +46,16 @@ def main():
         split = int(0.8 * len(x))
         train, val = (x[:split], y[:split]), (x[split:], y[split:])
 
-        try:
-            from analytics_zoo_tpu.automl.xgboost import AutoXGBRegressor
-            auto = AutoXGBRegressor(n_jobs=2)
-            auto.fit(train, validation_data=val, metric="rmse",
-                     search_space={
-                         "n_estimators": hp.grid_search([50, 150]),
-                         "max_depth": hp.grid_search([3, 6]),
-                         "learning_rate": hp.loguniform(1e-2, 3e-1),
-                     }, n_sampling=max(1, args.trials // 4))
-            pred = auto.predict(val[0]).reshape(-1)
-            engine_name = "AutoXGBRegressor"
-        except ImportError:
-            # xgboost extra not installed -> same hp search over the
-            # AutoEstimator MLP builder (identical search surface)
-            import flax.linen as nn
-
-            from analytics_zoo_tpu.automl.auto_estimator import AutoEstimator
-
-            def model_creator(config):
-                class MLP(nn.Module):
-                    @nn.compact
-                    def __call__(self, t):
-                        for _ in range(int(config["layers"])):
-                            t = nn.relu(nn.Dense(int(config["hidden"]))(t))
-                        return nn.Dense(1)(t)[..., 0]
-                return MLP()
-
-            auto = AutoEstimator.from_keras(
-                model_creator=model_creator, loss="mean_squared_error")
-            auto.fit(data={"x": train[0], "y": train[1]},
-                     validation_data={"x": val[0], "y": val[1]},
-                     metric="mse", metric_mode="min",
-                     search_space={
-                         "layers": hp.grid_search([1, 2]),
-                         "hidden": hp.grid_search([32, 64]),
-                         "lr": hp.loguniform(1e-3, 1e-2),
-                         "batch_size": 256,
-                     }, n_sampling=max(1, args.trials // 4), epochs=3)
-            best = auto.get_best_model()
-            pred = np.asarray(best.predict(val[0])).reshape(-1)
-            engine_name = "AutoEstimator (xgboost extra not installed)"
+        from analytics_zoo_tpu.automl.xgboost import AutoXGBRegressor
+        auto = AutoXGBRegressor(n_jobs=2)
+        auto.fit(train, validation_data=val, metric="rmse",
+                 search_space={
+                     "n_estimators": hp.grid_search([50, 150]),
+                     "max_depth": hp.grid_search([3, 6]),
+                     "learning_rate": hp.loguniform(1e-2, 3e-1),
+                 }, n_sampling=max(1, args.trials // 4))
+        pred = auto.predict(val[0]).reshape(-1)
+        engine_name = f"AutoXGBRegressor[{type(auto.get_best_model()).__name__}]"
 
         rmse = float(np.sqrt(np.mean((pred - val[1]) ** 2)))
         base = float(np.sqrt(np.mean((val[1].mean() - val[1]) ** 2)))
