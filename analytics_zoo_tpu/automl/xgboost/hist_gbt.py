@@ -145,10 +145,13 @@ def _bin_data(x: np.ndarray, edges: List[np.ndarray]) -> np.ndarray:
 
 class _BaseGBT:
     # xgboost params that are accepted silently — they tune execution, not
-    # the model, and have no equivalent here
+    # the model, and have no equivalent here. "objective"/"eval_metric" are
+    # deliberately NOT in this set: objective selects the loss, and this
+    # backend only implements squared-error/logistic/softmax — swallowing a
+    # non-default objective would silently train the wrong model.
     _EXECUTION_PARAMS = frozenset({
         "n_jobs", "nthread", "verbosity", "tree_method", "device",
-        "objective", "eval_metric", "early_stopping_rounds", "booster"})
+        "early_stopping_rounds", "booster"})
 
     def __init__(self, n_estimators: int = 100, max_depth: int = 6,
                  learning_rate: float = 0.3, reg_lambda: float = 1.0,
@@ -257,7 +260,12 @@ class ZooGBTClassifier(_BaseGBT):
 
     def _n_outputs(self, y) -> int:
         self.classes_ = np.unique(y)
-        return 1 if len(self.classes_) <= 2 else len(self.classes_)
+        if len(self.classes_) < 2:
+            raise ValueError(
+                "ZooGBTClassifier needs at least 2 classes in y; got "
+                f"{self.classes_!r} (single-class folds/slices cannot be "
+                "fit — filter them before training)")
+        return 1 if len(self.classes_) == 2 else len(self.classes_)
 
     def _base_score(self, y) -> np.ndarray:
         if len(self.classes_) <= 2:

@@ -128,7 +128,9 @@ class TrainEngine:
         small = tuple(jnp.asarray(a[:1]) for a in sample_x)
         variables = self._init_vars(rng, small)
         variables = dict(variables)
-        params = variables.pop("params")
+        # a parameterless graph (e.g. a pure merge/functional model) inits
+        # with no "params" collection at all
+        params = variables.pop("params", {})
         params, variables = self._capture_tp_specs(params, variables)
         self.params = jax.device_put(params, self._param_sharding(params))
         self.extra_vars = jax.device_put(

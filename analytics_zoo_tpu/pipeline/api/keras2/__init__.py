@@ -1,0 +1,17 @@
+"""keras2 API — the reference's tf.keras-style argument-name surface
+(reference: pyzoo/zoo/pipeline/api/keras2/, 1,026 LoC of py4j wrappers
+whose only delta from keras v1 is naming: units/filters/kernel_size/rate/
+padding/data_format instead of output_dim/nb_filter/p/border_mode/
+dim_ordering, plus Maximum/Minimum/Average merge classes).
+
+TPU-native collapse: keras2 factories return the SAME flax modules as the
+v1 API, so both surfaces share one implementation, one Sequential/Model
+engine, and one estimator/compile path. The reference's keras2 engine/
+topology.py and engine/training.py are license-only stubs; Sequential,
+Model and Input are re-exported from the v1 engine here for symmetry.
+"""
+
+from ..keras.engine.topology import Input, Model, Sequential
+from . import layers
+
+__all__ = ["Input", "Model", "Sequential", "layers"]

@@ -1,0 +1,22 @@
+"""keras2 layer namespace (reference: pyzoo/zoo/pipeline/api/keras2/layers/
+__init__.py star-imports merge/core/convolutional/pooling/local/...; the
+reference's recurrent/normalization/embeddings/noise/advanced_activations/
+wrappers/convolutional_recurrent files are license-only stubs with no
+classes, so there is nothing to mirror for them)."""
+
+from .convolutional import Conv1D, Conv2D, Cropping1D
+from .core import Activation, Dense, Dropout, Flatten
+from .local import LocallyConnected1D
+from .merge import (Average, Maximum, Minimum, average, maximum, minimum)
+from .pooling import (AveragePooling1D, GlobalAveragePooling1D,
+                      GlobalAveragePooling2D, GlobalMaxPooling1D,
+                      MaxPooling1D)
+
+__all__ = [
+    "Conv1D", "Conv2D", "Cropping1D",
+    "Activation", "Dense", "Dropout", "Flatten",
+    "LocallyConnected1D",
+    "Average", "Maximum", "Minimum", "average", "maximum", "minimum",
+    "AveragePooling1D", "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalMaxPooling1D", "MaxPooling1D",
+]

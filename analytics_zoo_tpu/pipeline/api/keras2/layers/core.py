@@ -1,0 +1,49 @@
+"""keras2 core layers — tf.keras argument names over the keras-v1 flax
+modules (reference: pyzoo/zoo/pipeline/api/keras2/layers/core.py — Dense,
+Activation, Dropout, Flatten with `units`/`rate`/`kernel_initializer`
+naming instead of the v1 `output_dim`/`p`/`init`).
+
+Each factory returns the SAME flax module class the keras-v1 API builds,
+so keras2 layers compose freely with v1 layers, Sequential/Model, and the
+whole estimator stack; only the constructor surface differs.
+"""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+
+__all__ = ["Dense", "Activation", "Dropout", "Flatten"]
+
+
+def _shape(input_dim, input_shape):
+    if input_dim:
+        return (input_dim,)
+    return tuple(input_shape) if input_shape else None
+
+
+def Dense(units, kernel_initializer="glorot_uniform",
+          bias_initializer="zero", activation=None, kernel_regularizer=None,
+          bias_regularizer=None, use_bias=True, input_dim=None,
+          input_shape=None, **kwargs):
+    """reference keras2/layers/core.py Dense(units, kernel_initializer, ...)"""
+    del bias_initializer   # v1 biases are zero-initialized, same default
+    return K1.Dense(output_dim=int(units), activation=activation,
+                    use_bias=use_bias, init_method=kernel_initializer,
+                    W_regularizer=kernel_regularizer,
+                    b_regularizer=bias_regularizer,
+                    input_shape=_shape(input_dim, input_shape), **kwargs)
+
+
+def Activation(activation, input_shape=None, **kwargs):
+    return K1.Activation(activation=activation,
+                         input_shape=_shape(None, input_shape), **kwargs)
+
+
+def Dropout(rate, input_shape=None, **kwargs):
+    """keras2 names the drop fraction ``rate`` (v1: ``p``)."""
+    return K1.Dropout(p=float(rate),
+                      input_shape=_shape(None, input_shape), **kwargs)
+
+
+def Flatten(input_shape=None, **kwargs):
+    return K1.Flatten(input_shape=_shape(None, input_shape), **kwargs)

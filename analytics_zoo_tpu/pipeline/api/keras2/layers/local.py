@@ -1,0 +1,28 @@
+"""keras2 locally-connected layers (reference: pyzoo/zoo/pipeline/api/
+keras2/layers/local.py — LocallyConnected1D with filters/kernel_size
+naming; only padding='valid' is supported, as in the reference)."""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+
+__all__ = ["LocallyConnected1D"]
+
+
+def LocallyConnected1D(filters, kernel_size, strides=1, padding="valid",
+                       activation=None, kernel_regularizer=None,
+                       bias_regularizer=None, use_bias=True,
+                       input_shape=None, **kwargs):
+    if padding != "valid":
+        raise ValueError("For LocallyConnected1D, only padding='valid' is "
+                         "supported for now")
+    del kernel_regularizer, bias_regularizer
+    if isinstance(kernel_size, (tuple, list)):
+        kernel_size = kernel_size[0]
+    if isinstance(strides, (tuple, list)):
+        strides = strides[0]
+    return K1.LocallyConnected1D(
+        nb_filter=int(filters), filter_length=int(kernel_size),
+        activation=activation, subsample_length=int(strides),
+        use_bias=use_bias,
+        input_shape=tuple(input_shape) if input_shape else None, **kwargs)

@@ -1,0 +1,89 @@
+"""keras2 API: tf.keras-style argument names must build the same flax
+layers as keras v1 and train through the shared Sequential engine
+(reference: pyzoo/zoo/pipeline/api/keras2/ — the whole package is an
+arg-name delta over keras v1; SURVEY §2.1 pipeline.api.keras/keras2)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api import keras2
+from analytics_zoo_tpu.pipeline.api.keras import layers as K1
+from analytics_zoo_tpu.pipeline.api.keras2 import layers as K2
+
+
+def test_factories_build_v1_modules():
+    d = K2.Dense(10, activation="relu", input_dim=8)
+    assert isinstance(d, K1.Dense)
+    assert d.output_dim == 10 and d.input_shape == (8,)
+
+    dr = K2.Dropout(0.25)
+    assert isinstance(dr, K1.Dropout) and dr.p == 0.25
+
+    c2 = K2.Conv2D(6, (3, 3), strides=(2, 2), padding="same",
+                   data_format="channels_last")
+    assert isinstance(c2, K1.Convolution2D)
+    assert (c2.nb_filter, c2.nb_row, c2.nb_col) == (6, 3, 3)
+    assert c2.subsample == (2, 2) and c2.dim_ordering == "tf"
+    assert c2.border_mode == "same"
+
+    c1 = K2.Conv1D(4, 5, strides=2)
+    assert isinstance(c1, K1.Convolution1D)
+    assert c1.filter_length == 5 and c1.subsample_length == 2
+
+    mp = K2.MaxPooling1D(pool_size=3, strides=2)
+    assert isinstance(mp, K1.MaxPooling1D)
+    assert mp.pool_length == 3 and mp.stride == 2
+
+    lc = K2.LocallyConnected1D(6, 3)
+    assert isinstance(lc, K1.LocallyConnected1D)
+    with pytest.raises(ValueError, match="valid"):
+        K2.LocallyConnected1D(6, 3, padding="same")
+
+
+def test_merge_layers_match_numpy():
+    a = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    b = np.random.RandomState(1).rand(4, 5).astype(np.float32)
+    import jax
+
+    for fac, ref in ((K2.Maximum, np.maximum), (K2.Minimum, np.minimum),
+                     (K2.Average, lambda x, y: (x + y) / 2)):
+        layer = fac()
+        v = layer.init(jax.random.PRNGKey(0), a, b)
+        out = layer.apply(v, a, b)
+        np.testing.assert_allclose(np.asarray(out), ref(a, b), rtol=1e-6)
+
+
+def test_sequential_trains_with_keras2_layers(orca_context):
+    """A keras2-built Sequential must run the shared v1 engine end to end
+    (compile/fit/predict) — arg names are the only delta."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(128, 8).astype(np.float32)
+    w = rng.rand(8, 1).astype(np.float32)
+    y = (x @ w).reshape(-1)
+
+    model = keras2.Sequential([
+        K2.Dense(16, activation="relu", input_shape=(8,)),
+        K2.Dropout(0.0),
+        K2.Dense(1),
+    ])
+    model.compile(optimizer="adam", loss="mse")
+    stats = model.fit(x, y.reshape(-1, 1), batch_size=32, nb_epoch=8,
+                      verbose=False)
+    assert stats[-1]["train_loss"] < stats[0]["train_loss"]
+    pred = model.predict(x)
+    assert np.asarray(pred).shape[0] == 128
+
+
+def test_functional_merge_graph(orca_context):
+    """Functional maximum() over two Input branches through Model."""
+    import jax
+
+    i1 = keras2.Input(shape=(6,))
+    i2 = keras2.Input(shape=(6,))
+    out = K2.maximum([i1, i2])
+    model = keras2.Model([i1, i2], out)
+    a = np.random.RandomState(0).rand(3, 6).astype(np.float32)
+    b = np.random.RandomState(1).rand(3, 6).astype(np.float32)
+    pred = model.predict([a, b])
+    np.testing.assert_allclose(np.asarray(pred), np.maximum(a, b),
+                               rtol=1e-6)
