@@ -66,9 +66,15 @@ class TPUSearchEngine(SearchEngine):
     def compile(self, data, model_builder: Callable[[Dict], Any],
                 search_space: Dict[str, Any], n_sampling: int = 1,
                 epochs: int = 1, validation_data=None, metric: str = "mse",
-                metric_mode: str = "min", batch_size_key: str = "batch_size"):
+                metric_mode: str = "min", batch_size_key: str = "batch_size",
+                search_alg: Optional[str] = None):
         """model_builder(config, device_mesh) -> object with
-        fit_eval(data, validation_data, epochs, metric) -> (score, state)."""
+        fit_eval(data, validation_data, epochs, metric) -> (score, state).
+
+        ``search_alg="bayes"`` switches run() to a sequential GP-EI loop
+        over the continuous axes (reference: ray_tune_search_engine.py:176
+        wires the 'bayesopt' searcher; here search/bayes.py supplies a
+        dependency-free picker)."""
         self.data = data
         self.validation_data = validation_data
         self.model_builder = model_builder
@@ -78,6 +84,10 @@ class TPUSearchEngine(SearchEngine):
         self.metric = metric
         assert metric_mode in ("min", "max")
         self.metric_mode = metric_mode
+        if search_alg not in (None, "bayes"):
+            raise ValueError(f"unknown search_alg {search_alg!r} "
+                             "(supported: None, 'bayes')")
+        self.search_alg = search_alg
         # grid axes expand; the remaining axes are sampled n_sampling times
         grid = hp_dsl.grid_configs(search_space)
         rng = np.random.RandomState(self.seed)
@@ -120,7 +130,28 @@ class TPUSearchEngine(SearchEngine):
             trial.duration_s = time.time() - t0
             return trial
 
-        if workers <= 1 or len(self._trials) <= 1:
+        if getattr(self, "search_alg", None) == "bayes":
+            # sequential by construction: each proposal conditions on every
+            # completed trial (grid/choice axes keep per-trial random draws)
+            from .bayes import GPEIPicker, SpaceCodec
+
+            codec = SpaceCodec(self.search_space)
+            picker = GPEIPicker(max(codec.dim, 1))
+            rng = np.random.RandomState(self.seed + 1)
+            n_init = max(2, len(self._trials) // 3)
+            sign = 1.0 if self.metric_mode == "min" else -1.0
+            for i, trial in enumerate(self._trials):
+                if codec.dim and i >= n_init:
+                    resampled = hp_dsl.sample_config(self.search_space, rng)
+                    trial.config = codec.decode_into(
+                        picker.suggest(rng), resampled)
+                run_trial(trial)
+                if codec.dim:
+                    score = (trial.metric_value if trial.state == "done"
+                             else float("inf"))
+                    picker.observe(codec.encode(trial.config),
+                                   sign * score)
+        elif workers <= 1 or len(self._trials) <= 1:
             for t in self._trials:
                 run_trial(t)
         else:

@@ -47,7 +47,8 @@ class AutoTSTrainer:
                 self.mesh = mesh
 
             def fit_eval(self, data, validation_data, epochs, metric):
-                cfg = self.config
+                from ..config.recipe import convert_bayes_config
+                cfg = convert_bayes_config(self.config)
                 past = int(cfg.get("past_seq_len", 50))
                 tsft = TimeSequenceFeatureTransformer(
                     horizon=trainer.horizon, dt_col=trainer.dt_col,
@@ -81,11 +82,16 @@ class AutoTSTrainer:
                        space, n_sampling=recipe.num_samples,
                        epochs=getattr(recipe, "training_iteration", 5),
                        validation_data=validation_df, metric=metric,
-                       metric_mode="min")
+                       metric_mode="min",
+                       search_alg=getattr(recipe, "search_algorithm", None))
         engine.run()
         best = engine.get_best_trial()
+        from ..config.recipe import convert_bayes_config
+        # store the CONVERTED config: downstream consumers (incremental
+        # TSPipeline.fit, save/load) read plain keys like batch_size
         return TSPipeline(best.model_state["forecaster"],
-                          best.model_state["tsft"], best.config, self)
+                          best.model_state["tsft"],
+                          convert_bayes_config(best.config), self)
 
     def _build_forecaster(self, model_type: str, cfg: Dict, feature_num: int):
         if model_type == "TCN":
@@ -114,10 +120,19 @@ class AutoTSTrainer:
                 cnn_hid_size=int(cfg.get("cnn_hid_size", 32)),
                 lr=float(cfg.get("lr", 1e-3)),
                 loss=cfg.get("loss", "mse"))
+        if "lstm_1_units" in cfg:
+            # BayesRecipe layout: per-layer units/dropout keys (the
+            # reference's VanillaLSTM reads the same names)
+            units = (int(cfg["lstm_1_units"]),
+                     int(cfg.get("lstm_2_units", cfg["lstm_1_units"])))
+            dropouts = (float(cfg.get("dropout_1", 0.2)),
+                        float(cfg.get("dropout_2", 0.2)))
+        else:
+            units = cfg.get("lstm_units", (16, 8))
+            dropouts = cfg.get("dropouts", 0.2)
         return LSTMForecaster(
             target_dim=self.horizon, feature_dim=feature_num,
-            lstm_units=cfg.get("lstm_units", (16, 8)),
-            dropouts=cfg.get("dropouts", 0.2),
+            lstm_units=units, dropouts=dropouts,
             lr=float(cfg.get("lr", 1e-3)), loss=cfg.get("loss", "mse"))
 
 

@@ -12,12 +12,27 @@ from ...automl import hp
 class Recipe:
     num_samples = 1
     training_iteration = 10
+    search_algorithm = None        # None (grid+random) | "bayes"
 
     def search_space(self, all_available_features: List[str]) -> Dict:
         raise NotImplementedError
 
     def model_type(self) -> str:
         return "LSTM"
+
+
+def convert_bayes_config(config: Dict) -> Dict:
+    """``*_float`` keys -> ints under the stripped name (the reference's
+    bayes convention, automl/common/util.py:207: bayes searchers model a
+    continuous space, so integer hyperparameters are searched as floats
+    and rounded when the model consumes them)."""
+    out = {}
+    for k, v in config.items():
+        if k.endswith("_float"):
+            out[k[:-len("_float")]] = int(v)
+        else:
+            out[k] = v
+    return out
 
 
 class SmokeRecipe(Recipe):
@@ -198,6 +213,61 @@ class RandomRecipe(Recipe):
             "lr": hp.loguniform(1e-4, 1e-1),
             "loss": "mse",
         }
+
+    def model_type(self):
+        return "LSTM"
+
+
+class BayesRecipe(Recipe):
+    """Bayes-search LSTM recipe (reference: recipe.py:568 BayesRecipe over
+    ray-tune's bayesopt searcher). Integer hyperparameters are expressed
+    as ``*_float`` uniforms (bayes models a continuous space) and rounded
+    via :func:`convert_bayes_config` when consumed; trials run through
+    TPUSearchEngine's sequential GP-EI loop (automl/search/bayes.py)."""
+
+    search_algorithm = "bayes"
+
+    def __init__(self, num_samples: int = 1, look_back=2, epochs: int = 5,
+                 reward_metric: float = -0.05, training_iteration: int = 5):
+        self.num_samples = num_samples
+        self.reward_metric = reward_metric
+        self.training_iteration = training_iteration
+        self.epochs = epochs
+        if (isinstance(look_back, tuple) and len(look_back) == 2
+                and all(isinstance(v, int) for v in look_back)):
+            if look_back[1] < 2:
+                raise ValueError("The max look back value should be at "
+                                 "least 2")
+            if look_back[0] > look_back[1]:
+                raise ValueError(
+                    f"look back range is inverted: {look_back} — expected "
+                    "(min_len, max_len) with min_len <= max_len")
+            self.bayes_past_seq_config = {
+                "past_seq_len_float": hp.uniform(max(look_back[0], 2),
+                                                 look_back[1])}
+        elif isinstance(look_back, int):
+            if look_back < 2:
+                raise ValueError("look back value should not be smaller "
+                                 f"than 2. Current value is {look_back}")
+            self.bayes_past_seq_config = {"past_seq_len": look_back}
+        else:
+            raise ValueError(
+                f"look back is {look_back}. look_back should be either a "
+                "tuple of 2 ints (min_len, max_len) or a single int")
+
+    def search_space(self, all_available_features=None):
+        space = {
+            "model": "LSTM",
+            "lstm_1_units_float": hp.uniform(8, 128),
+            "dropout_1": hp.uniform(0.2, 0.5),
+            "lstm_2_units_float": hp.uniform(8, 128),
+            "dropout_2": hp.uniform(0.2, 0.5),
+            "lr": hp.uniform(0.001, 0.1),
+            "batch_size_float": hp.uniform(32, 128),
+            "loss": "mse",
+        }
+        space.update(self.bayes_past_seq_config)
+        return space
 
     def model_type(self):
         return "LSTM"
