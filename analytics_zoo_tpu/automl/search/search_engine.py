@@ -67,14 +67,21 @@ class TPUSearchEngine(SearchEngine):
                 search_space: Dict[str, Any], n_sampling: int = 1,
                 epochs: int = 1, validation_data=None, metric: str = "mse",
                 metric_mode: str = "min", batch_size_key: str = "batch_size",
-                search_alg: Optional[str] = None):
+                search_alg: Optional[str] = None,
+                stop_score: Optional[float] = None):
         """model_builder(config, device_mesh) -> object with
         fit_eval(data, validation_data, epochs, metric) -> (score, state).
 
         ``search_alg="bayes"`` switches run() to a sequential GP-EI loop
         over the continuous axes (reference: ray_tune_search_engine.py:176
         wires the 'bayesopt' searcher; here search/bayes.py supplies a
-        dependency-free picker)."""
+        dependency-free picker).
+
+        ``stop_score``: early-stop threshold (the reference recipes'
+        ``reward_metric`` wired into tune's stop condition) — sequential
+        runs stop launching trials once a completed trial reaches it
+        (<= for metric_mode 'min', >= for 'max'). Thread-pool runs ignore
+        it (trials are already in flight)."""
         self.data = data
         self.validation_data = validation_data
         self.model_builder = model_builder
@@ -88,6 +95,7 @@ class TPUSearchEngine(SearchEngine):
             raise ValueError(f"unknown search_alg {search_alg!r} "
                              "(supported: None, 'bayes')")
         self.search_alg = search_alg
+        self.stop_score = stop_score
         # grid axes expand; the remaining axes are sampled n_sampling times
         grid = hp_dsl.grid_configs(search_space)
         rng = np.random.RandomState(self.seed)
@@ -130,6 +138,13 @@ class TPUSearchEngine(SearchEngine):
             trial.duration_s = time.time() - t0
             return trial
 
+        def reached_stop(trial):
+            if self.stop_score is None or trial.state != "done":
+                return False
+            if self.metric_mode == "min":
+                return trial.metric_value <= self.stop_score
+            return trial.metric_value >= self.stop_score
+
         if getattr(self, "search_alg", None) == "bayes":
             # sequential by construction: each proposal conditions on every
             # completed trial (grid/choice axes keep per-trial random draws)
@@ -151,9 +166,15 @@ class TPUSearchEngine(SearchEngine):
                              else float("inf"))
                     picker.observe(codec.encode(trial.config),
                                    sign * score)
+                if reached_stop(trial):
+                    self._trials = self._trials[:i + 1]
+                    break
         elif workers <= 1 or len(self._trials) <= 1:
-            for t in self._trials:
+            for i, t in enumerate(self._trials):
                 run_trial(t)
+                if reached_stop(t):
+                    self._trials = self._trials[:i + 1]
+                    break
         else:
             with ThreadPoolExecutor(max_workers=workers) as pool:
                 list(pool.map(run_trial, self._trials))

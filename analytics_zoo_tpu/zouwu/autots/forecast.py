@@ -13,7 +13,8 @@ import pandas as pd
 
 from ...automl import hp
 from ...automl.search.search_engine import TPUSearchEngine
-from ..config.recipe import LSTMGridRandomRecipe, Recipe
+from ..config.recipe import (LSTMGridRandomRecipe, Recipe,
+                             convert_bayes_config)
 from ..feature.time_sequence import TimeSequenceFeatureTransformer
 from ..model.forecast import LSTMForecaster, Seq2SeqForecaster, TCNForecaster
 
@@ -47,7 +48,6 @@ class AutoTSTrainer:
                 self.mesh = mesh
 
             def fit_eval(self, data, validation_data, epochs, metric):
-                from ..config.recipe import convert_bayes_config
                 cfg = convert_bayes_config(self.config)
                 past = int(cfg.get("past_seq_len", 50))
                 tsft = TimeSequenceFeatureTransformer(
@@ -78,15 +78,18 @@ class AutoTSTrainer:
                 return score, {metric: score}, state
 
         engine = TPUSearchEngine(name=self.name)
+        # reference recipes' reward_metric is a tune reward (maximized
+        # negative loss): reward_metric=-0.05 stops once mse <= 0.05
+        reward = getattr(recipe, "reward_metric", None)
         engine.compile(train_df, lambda cfg, mesh: _TSTrialModel(cfg, mesh),
                        space, n_sampling=recipe.num_samples,
                        epochs=getattr(recipe, "training_iteration", 5),
                        validation_data=validation_df, metric=metric,
                        metric_mode="min",
-                       search_alg=getattr(recipe, "search_algorithm", None))
+                       search_alg=getattr(recipe, "search_algorithm", None),
+                       stop_score=None if reward is None else -reward)
         engine.run()
         best = engine.get_best_trial()
-        from ..config.recipe import convert_bayes_config
         # store the CONVERTED config: downstream consumers (incremental
         # TSPipeline.fit, save/load) read plain keys like batch_size
         return TSPipeline(best.model_state["forecaster"],

@@ -80,6 +80,25 @@ def test_engine_bayes_beats_random_on_quadratic(orca_context):
                                   search_alg="annealing")
 
 
+def test_stop_score_ends_search_early(orca_context):
+    """reward_metric wiring: a sequential run stops launching trials once a
+    completed trial reaches stop_score (reference recipes feed
+    reward_metric into tune's stop condition)."""
+
+    class _Always:
+        def __init__(self, config, mesh):
+            pass
+
+        def fit_eval(self, data, validation_data, epochs, metric):
+            return 0.01, {metric: 0.01}, None
+
+    engine = TPUSearchEngine(name="stop-test", max_concurrent=1)
+    engine.compile(None, _Always, {"x": hp.uniform(0, 1)}, n_sampling=10,
+                   metric="mse", metric_mode="min", stop_score=0.05)
+    trials = engine.run()
+    assert len(trials) == 1                 # stopped after the first hit
+
+
 def test_bayes_recipe_autots_end_to_end(orca_context):
     """BayesRecipe through AutoTSTrainer: sequential GP-EI trials, _float
     keys converted, pipeline predicts."""
