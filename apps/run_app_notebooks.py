@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Execute every apps/ notebook cell-by-cell (no jupyter kernel needed) —
+the smoke runner for the notebook corpus (reference analogue:
+apps/run-app-tests*.sh executing the notebook apps in CI).
+
+Usage: python apps/run_app_notebooks.py [name-substring ...]
+"""
+
+import glob
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def run_notebook(path: str) -> None:
+    import nbformat
+    nb = nbformat.read(path, as_version=4)
+    ns = {"__name__": "__main__"}
+    for i, cell in enumerate(nb.cells):
+        if cell.cell_type != "code":
+            continue
+        try:
+            exec(compile(cell.source, f"{path}:cell{i}", "exec"), ns)
+        except Exception:
+            print(f"FAILED in {path} cell {i}:\n{cell.source}")
+            raise
+
+
+def main():
+    filters = sys.argv[1:]
+    paths = sorted(glob.glob(os.path.join(ROOT, "apps", "**", "*.ipynb"),
+                             recursive=True))
+    if filters:
+        paths = [p for p in paths if any(f in p for f in filters)]
+    for p in paths:
+        t0 = time.time()
+        run_notebook(p)
+        print(f"OK {os.path.relpath(p, ROOT)} ({time.time() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,8 +58,10 @@ def main():
 
         preds = ad.predict(x)
         top_k = 12 * len(incidents)
+        # detect_anomalies returns (index, y_true, y_pred) per flagged point
         flagged = AnomalyDetector.detect_anomalies(y, preds, top_k)
-        flagged_idx = np.asarray(sorted(flagged)) + args.unroll
+        flagged_idx = np.asarray(sorted(i for i, _, _ in flagged)) \
+            + args.unroll
 
         hits = sum(1 for s in incidents
                    if np.any((flagged_idx >= s) & (flagged_idx < s + 12)))

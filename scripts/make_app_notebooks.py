@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""Generate the apps/ notebook corpus (reference: /root/reference/apps/ —
+19 notebook apps; SURVEY §2.1 examples/apps row).
+
+Each notebook is narrated markdown + small code cells adapted from the
+smoke-tested examples/ scripts (argparse replaced by inline parameters,
+sized to run in minutes on CPU or one chip). Regenerate with:
+
+    python scripts/make_app_notebooks.py
+
+apps/run_app_notebooks.py executes every generated notebook cell-by-cell
+(no jupyter needed) and is part of the smoke story;
+tests/test_app_notebooks.py gates one end-to-end.
+"""
+
+import os
+import sys
+
+import nbformat as nbf
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SETUP = """\
+import numpy as np
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+ctx = init_orca_context("local")
+ctx"""
+
+
+def nb(cells):
+    book = nbf.v4.new_notebook()
+    book.cells = [
+        nbf.v4.new_markdown_cell(src) if kind == "md"
+        else nbf.v4.new_code_cell(src)
+        for kind, src in cells]
+    return book
+
+
+NOTEBOOKS = {
+ "apps/recommendation-ncf/ncf-explicit-feedback.ipynb": [
+  ("md", "# NCF explicit-feedback recommendation\n\n"
+         "Neural Collaborative Filtering on MovieLens-shaped "
+         "(user, item) → rating data (reference app: "
+         "`apps/recommendation-ncf/ncf-explicit-feedback.ipynb`; model "
+         "parity: `pyzoo/zoo/models/recommendation/neuralcf.py`). The "
+         "model trains through the jitted TPU engine; point `ratings.dat` "
+         "at real MovieLens-1M to reproduce the reference app."),
+  ("code", SETUP),
+  ("md", "## Data\n\nSynthetic ml-1m-shaped ratings (swap in "
+         "`load_movielens` from `examples/orca/learn/ncf_movielens.py` "
+         "for the real file)."),
+  ("code", """\
+n, n_users, n_items = 100_000, 6040, 3706
+rng = np.random.RandomState(0)
+pairs = np.stack([rng.randint(1, n_users, n),
+                  rng.randint(1, n_items, n)], -1).astype(np.int32)
+ratings = rng.randint(0, 5, n).astype(np.int32)
+pairs[:3], ratings[:3]"""),
+  ("md", "## Model + training\n\nMLP tower over fused user/item embedding "
+         "tables plus a GMF branch; the embedding backward runs as a "
+         "one-hot matmul on the MXU (`ops/embedding.py`)."),
+  ("code", """\
+from analytics_zoo_tpu.models.recommendation import NeuralCF
+from analytics_zoo_tpu.orca.learn.optimizers import Adam
+
+model = NeuralCF(user_count=n_users, item_count=n_items, class_num=5,
+                 user_embed=32, item_embed=32, mf_embed=32,
+                 hidden_layers=(64, 32, 16))
+model.compile(loss="sparse_categorical_crossentropy",
+              optimizer=Adam(lr=1e-3), metrics=["accuracy"])
+stats = model.fit({"x": pairs, "y": ratings}, epochs=2, batch_size=8192,
+                  verbose=False)
+stats[-1]"""),
+  ("md", "## Recommend\n\nRank candidate items per user from the "
+         "predicted rating distribution."),
+  ("code", """\
+recs = model.recommend_for_user(pairs[:200], max_items=3)
+dict(list(recs.items())[:3])"""),
+  ("code", "stop_orca_context()"),
+ ],
+
+ "apps/recommendation-wide-n-deep/wide_n_deep.ipynb": [
+  ("md", "# Wide & Deep recommendation\n\nCensus-shaped wide crosses + "
+         "indicators + embeddings + continuous features through the two "
+         "towers (reference app: `apps/recommendation-wide-n-deep`; model "
+         "parity: `models/recommendation/wide_and_deep.py:94`)."),
+  ("code", SETUP),
+  ("code", """\
+n = 20_000
+rng = np.random.RandomState(0)
+occupation = rng.randint(0, 12, n); education = rng.randint(0, 8, n)
+gender = rng.randint(0, 2, n)
+age, hours = rng.rand(n).astype(np.float32), rng.rand(n).astype(np.float32)
+label = ((0.8 * (occupation >= 8) + 0.6 * (education >= 5) + 1.2 * age
+          + 0.7 * hours - 1.6 + 0.3 * rng.randn(n)) > 0).astype(np.int32)"""),
+  ("md", "`ColumnFeatureInfo` declares the column roles, exactly like the "
+         "reference; the flat feature row is wide one-hots | indicators | "
+         "embed ids | continuous."),
+  ("code", """\
+from analytics_zoo_tpu.models.recommendation import (ColumnFeatureInfo,
+                                                     WideAndDeep)
+ci = ColumnFeatureInfo(wide_base_cols=["occupation", "gender"],
+                       wide_base_dims=[12, 2],
+                       indicator_cols=["education"], indicator_dims=[8],
+                       embed_cols=["occupation"], embed_in_dims=[12],
+                       embed_out_dims=[8],
+                       continuous_cols=["age", "hours"])
+wide = np.zeros((n, 14), np.float32)
+wide[np.arange(n), occupation] = 1.0
+wide[np.arange(n), 12 + gender] = 1.0
+indicator = np.zeros((n, 8), np.float32)
+indicator[np.arange(n), education] = 1.0
+x = np.concatenate([wide, indicator,
+                    occupation.astype(np.float32)[:, None],
+                    np.stack([age, hours], -1)], axis=1)
+assert x.shape[1] == ci.feature_width()"""),
+  ("code", """\
+split = int(0.9 * n)
+model = WideAndDeep(2, ci, model_type="wide_n_deep",
+                    hidden_layers=(40, 20, 10))
+model.compile(loss="sparse_categorical_crossentropy", optimizer="adam",
+              metrics=["accuracy"])
+model.fit({"x": x[:split], "y": label[:split]}, epochs=3, batch_size=2048,
+          verbose=False)
+probs = model.predict(x[split:])
+acc = float((np.argmax(probs, -1) == label[split:]).mean())
+print(f"holdout accuracy = {acc:.3f}")"""),
+  ("code", "stop_orca_context()"),
+ ],
+
+ "apps/anomaly-detection/anomaly-detection-nyc-taxi.ipynb": [
+  ("md", "# Anomaly detection on a univariate series\n\nTaxi-demand-shaped "
+         "series → `AnomalyDetector.unroll` → RNN forecaster → flag the "
+         "largest forecast errors (reference app: `apps/anomaly-detection/"
+         "anomaly-detection-nyc-taxi.ipynb`; model parity: "
+         "`models/anomalydetection/anomaly_detector.py:30`)."),
+  ("code", SETUP),
+  ("code", """\
+n, unroll = 1500, 24
+rng = np.random.RandomState(0)
+t = np.arange(n)
+series = (10 + 3 * np.sin(t / 48 * 2 * np.pi)
+          + 0.4 * np.sin(t / (48 * 7) * 2 * np.pi)
+          + 0.15 * rng.randn(n)).astype(np.float32)
+incidents = sorted(rng.choice(np.arange(200, n - 50), 4, replace=False))
+for s in incidents:
+    series[s:s + 12] *= 0.35          # demand collapse windows"""),
+  ("code", """\
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+normed = ((series - series.mean()) / series.std()).reshape(-1, 1)
+x, y = AnomalyDetector.unroll(normed, unroll_length=unroll)
+split = int(0.6 * len(x))
+ad = AnomalyDetector(feature_shape=(unroll, 1), hidden_layers=[32, 16],
+                     dropouts=[0.1, 0.1])
+ad.compile(loss="mean_squared_error", optimizer="adam")
+ad.fit({"x": x[:split], "y": y[:split]}, epochs=3, batch_size=256,
+       verbose=False)"""),
+  ("code", """\
+preds = ad.predict(x)
+# detect_anomalies returns (index, y_true, y_pred) per flagged point
+flagged = AnomalyDetector.detect_anomalies(y, preds, 12 * len(incidents))
+flagged_idx = np.asarray(sorted(i for i, _, _ in flagged)) + unroll
+hits = sum(1 for s in incidents
+           if np.any((flagged_idx >= s) & (flagged_idx < s + 12)))
+print(f"detected {hits}/{len(incidents)} injected incident windows")"""),
+  ("code", "stop_orca_context()"),
+ ],
+
+ "apps/automl/autots_forecasting.ipynb": [
+  ("md", "# AutoTS: automated time-series forecasting\n\nHyperparameter "
+         "search over LSTM forecasters, trials chip-pinned on "
+         "TPUSearchEngine (reference app: `apps/automl/nyc_taxi_dataset."
+         "ipynb`; pipeline parity: `zouwu/autots/forecast.py`). Swap the "
+         "recipe for `BayesRecipe` to search with GP-EI."),
+  ("code", SETUP),
+  ("code", """\
+import pandas as pd
+n = 500
+ts = pd.date_range("2024-01-01", periods=n, freq="h")
+rng = np.random.RandomState(0)
+value = (np.sin(np.arange(n) / 24 * 2 * np.pi)
+         + 0.1 * rng.randn(n)).astype(np.float32)
+df = pd.DataFrame({"datetime": ts, "value": value})
+train_df, val_df = df.iloc[:450], df.iloc[450:]"""),
+  ("code", """\
+from analytics_zoo_tpu.zouwu.autots.forecast import AutoTSTrainer
+from analytics_zoo_tpu.zouwu.config.recipe import SmokeRecipe
+
+trainer = AutoTSTrainer(dt_col="datetime", target_col="value", horizon=1)
+pipeline = trainer.fit(train_df, validation_df=val_df,
+                       recipe=SmokeRecipe())
+pipeline.config"""),
+  ("code", """\
+forecast = pipeline.predict(val_df)
+print(pipeline.evaluate(val_df, metrics=["mse"]))
+forecast.head()"""),
+  ("code", "stop_orca_context()"),
+ ],
+
+ "apps/fraud-detection/fraud-detection.ipynb": [
+  ("md", "# Fraud detection with NNFrames\n\nClass-imbalanced tabular "
+         "fraud data through the Spark-ML-style `NNEstimator → NNModel."
+         "transform` flow over pandas DataFrames (reference app: "
+         "`apps/fraud-detection`; surface parity: `pipeline/nnframes/"
+         "nn_classifier.py`). BASELINE workload #3."),
+  ("code", SETUP),
+  ("code", """\
+import pandas as pd
+n, n_features = 20_000, 29
+rng = np.random.RandomState(0)
+y = (rng.rand(n) < 0.02).astype(np.float32)
+x = rng.randn(n, n_features).astype(np.float32)
+x[y == 1, :5] += 1.5
+df = pd.DataFrame({"features": list(x), "label": y})
+holdout = df.sample(frac=0.1, random_state=0)
+train = df.drop(holdout.index)"""),
+  ("code", """\
+import flax.linen as nn
+from analytics_zoo_tpu.pipeline.nnframes import NNEstimator
+
+class FraudMLP(nn.Module):
+    @nn.compact
+    def __call__(self, t):
+        t = nn.relu(nn.Dense(64)(t))
+        t = nn.relu(nn.Dense(32)(t))
+        return nn.sigmoid(nn.Dense(1)(t))[..., 0]
+
+est = (NNEstimator(FraudMLP(), criterion="binary_crossentropy")
+       .setBatchSize(1024).setMaxEpoch(3).setLearningRate(1e-3))
+nn_model = est.fit(train)"""),
+  ("code", """\
+scored = nn_model.transform(holdout)
+pred = (np.asarray(list(scored["prediction"])) > 0.5).astype(np.float32)
+truth = holdout["label"].to_numpy()
+recall = float((pred[truth == 1] == 1).mean())
+print(f"fraud recall = {recall:.3f} on {int(truth.sum())} frauds")"""),
+  ("code", "stop_orca_context()"),
+ ],
+
+ "apps/image-augmentation/image-augmentation.ipynb": [
+  ("md", "# ImageSet augmentation pipeline\n\nThe reference's ImageSet "
+         "transform chain (reference app: `apps/image-augmentation/"
+         "image-augmentation.ipynb`; surface parity: `feature/image/"
+         "ImageSet.scala:370`) on numpy-backed images."),
+  ("code", SETUP),
+  ("code", """\
+import os, tempfile
+from PIL import Image
+data_dir = tempfile.mkdtemp(prefix="nb_aug_")
+for cls in ("cats", "dogs"):
+    os.makedirs(os.path.join(data_dir, cls), exist_ok=True)
+    for i in range(4):
+        arr = (np.random.RandomState(i).rand(80, 96, 3) * 255
+               ).astype(np.uint8)
+        Image.fromarray(arr).save(
+            os.path.join(data_dir, cls, f"{cls}_{i}.jpg"))"""),
+  ("code", """\
+from analytics_zoo_tpu.feature.image import (
+    ImageBrightness, ImageCenterCrop, ImageChannelNormalize, ImageHFlip,
+    ImageResize, ImageSet, ImageSetToSample)
+
+iset = ImageSet.read(data_dir, with_label=True, one_based_label=False)
+pipeline = (ImageResize(72, 72)
+            | ImageCenterCrop(64, 64)
+            | ImageHFlip(p=0.5)
+            | ImageBrightness(-16, 16)
+            | ImageChannelNormalize(123.0, 117.0, 104.0, 58.4, 57.1, 57.4))
+augmented = iset.transform(pipeline)
+samples = augmented.transform(ImageSetToSample(target_keys=("label",)))
+parts = augmented.to_dataset(with_label=True).collect()
+x = np.concatenate([p["x"][0] for p in parts])
+y = np.concatenate([p["y"][0] for p in parts])
+print(x.shape, x.dtype, y.shape)"""),
+  ("code", "stop_orca_context()"),
+ ],
+
+ "apps/object-detection/object-detection-serving.ipynb": [
+  ("md", "# Object-detection serving\n\nSSD detections served through the "
+         "ClusterServing batching engine over the bundled Redis-protocol "
+         "transport (reference app: `apps/object-detection`; serving "
+         "parity: `serving/ClusterServing.scala`). BASELINE workload #5 — "
+         "load a trained detector with `ObjectDetector.load_model` for "
+         "real boxes."),
+  ("code", SETUP),
+  ("code", """\
+from analytics_zoo_tpu.models.image.objectdetection import ObjectDetector
+from analytics_zoo_tpu.serving import (ClusterServing, InMemoryBroker,
+                                       InputQueue, OutputQueue)
+
+det = ObjectDetector(class_names=("person", "car", "bike"),
+                     image_size=64, model_type="ssd_tiny", max_gt=4)
+det.compile()
+model = det.as_inference_model(max_detections=20)
+broker = InMemoryBroker()
+serving = ClusterServing(model, queue=broker, batch_size=16,
+                         batch_timeout_ms=5).start()"""),
+  ("code", """\
+iq, oq = InputQueue(queue=broker), OutputQueue(queue=broker)
+imgs = np.random.RandomState(0).rand(32, 64, 64, 3).astype(np.float32)
+uris = [iq.enqueue(f"img-{i}", t=imgs[i]) for i in range(32)]
+results = oq.dequeue(uris, timeout_s=300)
+dets = np.asarray(results[uris[0]])
+print(f"{len(results)} results; each {dets.shape} = "
+      "(x1,y1,x2,y2,score,class) x 20")
+serving.metrics()["stages"].keys()"""),
+  ("code", "serving.stop(); stop_orca_context()"),
+ ],
+
+ "apps/sentiment-analysis/sentiment-analysis.ipynb": [
+  ("md", "# Sentiment / text classification\n\nTokenized news-shaped text "
+         "through the TextClassifier CNN encoder (reference app: "
+         "`apps/sentiment-analysis`; model parity: `models/"
+         "textclassification/text_classifier.py:29`)."),
+  ("code", SETUP),
+  ("code", """\
+vocab, seq_len, n = 2000, 64, 4000
+rng = np.random.RandomState(0)
+x = rng.randint(1, vocab, (n, seq_len)).astype(np.int32)
+y = np.zeros(n, np.int32)
+# plant class-specific marker tokens so the model can learn
+for cls, marker in ((1, 7), (2, 23)):
+    rows = rng.choice(n, n // 3, replace=False)
+    x[rows, :6] = marker
+    y[rows] = cls"""),
+  ("code", """\
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+clf = TextClassifier(class_num=3, sequence_length=seq_len,
+                     encoder="cnn", encoder_output_dim=128,
+                     vocab_size=vocab, embed_dim=64)
+clf.compile(loss="sparse_categorical_crossentropy", optimizer="adam",
+            metrics=["accuracy"])
+clf.fit({"x": x, "y": y}, epochs=3, batch_size=256, verbose=False)
+res = clf.evaluate({"x": x, "y": y}, batch_size=256, verbose=False)
+print(res)"""),
+  ("code", "stop_orca_context()"),
+ ],
+}
+
+
+def main():
+    for rel, cells in NOTEBOOKS.items():
+        path = os.path.join(ROOT, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        nbf.write(nb(cells), path)
+        print("wrote", rel)
+
+
+if __name__ == "__main__":
+    main()

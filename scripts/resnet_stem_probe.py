@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""ResNet-50 stem A/B: conv7 vs space-to-depth (round-4 stretch item).
+
+Jits a full train step (fwd+bwd+SGD) for both stems and interleaves
+best-of-N scanned runs, so shared-chip contention cannot bias one side.
+
+Usage: python scripts/resnet_stem_probe.py [--batch 256] [--rounds 4]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--crop", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from analytics_zoo_tpu.models.image.resnet import ResNet50
+
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randint(
+        0, 255, (args.batch, args.crop, args.crop, 3)).astype(np.uint8))
+    y = jax.device_put(rng.randint(0, 1000, args.batch).astype(np.int32))
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    runs = {}
+    for stem in ("conv7", "s2d"):
+        model = ResNet50(num_classes=1000, stem=stem)
+        variables = model.init(jax.random.PRNGKey(0), np.zeros(
+            (1, args.crop, args.crop, 3), np.uint8), train=True)
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        opt_state = tx.init(params)
+
+        def loss_fn(params, batch_stats, x, y):
+            logits, mut = model.apply(
+                {"params": params, "batch_stats": batch_stats}, x,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, mut["batch_stats"]
+
+        @functools.partial(jax.jit, static_argnums=())
+        def multi(params, batch_stats, opt_state):
+            def body(carry, _):
+                params, batch_stats, opt_state = carry
+                (loss, batch_stats), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch_stats, x, y)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, batch_stats, opt_state), loss
+            (params, batch_stats, opt_state), losses = jax.lax.scan(
+                body, (params, batch_stats, opt_state), None,
+                length=args.steps)
+            return params, batch_stats, opt_state, losses[-1]
+
+        p, b, o, l = multi(params, batch_stats, opt_state)
+        float(l)                      # compile + warm
+        runs[stem] = {"fn": multi, "state": (p, b, o),
+                      "best": float("inf")}
+
+    for _ in range(args.rounds):
+        for stem, st in runs.items():
+            p, b, o = st["state"]
+            t0 = time.perf_counter()
+            p, b, o, l = st["fn"](p, b, o)
+            float(l)
+            st["best"] = min(st["best"],
+                             (time.perf_counter() - t0) / args.steps)
+            st["state"] = (p, b, o)
+
+    out = {s: {"ms_per_step": round(st["best"] * 1e3, 2),
+               "img_per_sec": round(args.batch / st["best"], 1)}
+           for s, st in runs.items()}
+    out["s2d_speedup"] = round(
+        runs["conv7"]["best"] / runs["s2d"]["best"], 4)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
