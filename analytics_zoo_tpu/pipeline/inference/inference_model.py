@@ -134,32 +134,61 @@ class InferenceModel:
         logger.info("quantized %d weight tensors to int8", n_quantized)
         return self
 
+    def _dump_blob(self, module) -> bytes:
+        """Serialize the checkpoint dict (one schema for save +
+        save_encrypted)."""
+        import cloudpickle as pickle
+        import jax
+        return pickle.dumps(
+            {"module": module,
+             "state": {"params": jax.device_get(self._variables["params"]),
+                       "extra_vars": {
+                           k: jax.device_get(v)
+                           for k, v in self._variables.items()
+                           if k != "params"}}})
+
+    def _load_blob(self, raw: bytes) -> "InferenceModel":
+        import io
+
+        import cloudpickle as pickle
+        blob = pickle.load(io.BytesIO(raw))
+        if "module" not in blob:
+            raise ValueError(
+                "checkpoint missing module; save with InferenceModel.save "
+                "or load_jax(module, variables)")
+        return self.load_jax(blob["module"],
+                             {"params": blob["state"]["params"],
+                              **blob["state"].get("extra_vars", {})})
+
     def load(self, model_path: str, weight_path: Optional[str] = None
              ) -> "InferenceModel":
         """Load an estimator checkpoint pickle (reference ``load`` loads
         BigDL models, inference_model.py:40)."""
-        import cloudpickle as pickle
         with open(model_path, "rb") as f:
-            blob = pickle.load(f)
-        if "module" in blob:
-            return self.load_jax(blob["module"],
-                                 {"params": blob["state"]["params"],
-                                  **blob["state"].get("extra_vars", {})})
-        raise ValueError(
-            "checkpoint missing module; save with InferenceModel.save or "
-            "load_jax(module, variables)")
+            return self._load_blob(f.read())
 
     def save(self, module, path: str):
-        import cloudpickle as pickle
-        import jax
         with open(path, "wb") as f:
-            pickle.dump({"module": module,
-                         "state": {"params": jax.device_get(
-                             self._variables["params"]),
-                             "extra_vars": {
-                                 k: jax.device_get(v)
-                                 for k, v in self._variables.items()
-                                 if k != "params"}}}, f)
+            f.write(self._dump_blob(module))
+
+    def save_encrypted(self, module, path: str, passphrase: str):
+        """Encrypted checkpoint at rest (the TPU-native analogue of the
+        reference's encrypted-model serving,
+        InferenceModel.scala:315-323 doLoadEncryptedOpenVINO): the
+        serialized checkpoint bytes are sealed with authenticated
+        encryption (utils/crypto.py — PBKDF2 key derivation, HMAC-CTR
+        stream cipher, encrypt-then-MAC)."""
+        from ...utils.crypto import encrypt_bytes
+        with open(path, "wb") as f:
+            f.write(encrypt_bytes(self._dump_blob(module), passphrase))
+
+    def load_encrypted(self, path: str, passphrase: str) -> "InferenceModel":
+        """Load a ``save_encrypted`` artifact. The integrity tag is
+        verified BEFORE unpickling, so a tampered file or wrong key fails
+        loudly without deserializing attacker-controlled bytes."""
+        from ...utils.crypto import decrypt_bytes
+        with open(path, "rb") as f:
+            return self._load_blob(decrypt_bytes(f.read(), passphrase))
 
     def load_tf(self, model_path: str, backend: str = "convert",
                 input_names=None, output_names=None, **_
