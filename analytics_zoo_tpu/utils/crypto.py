@@ -15,8 +15,8 @@ Scheme (stdlib only — the TPU image carries no cryptography package):
   PRF→stream-cipher construction). The keystream is generated with one
   single-iteration PBKDF2 call — PBKDF2's block function at iterations=1
   IS HMAC(key, nonce ‖ counter_be32), and hashlib.pbkdf2_hmac runs the
-  whole block chain in OpenSSL C (~200 MB/s measured vs ~15 MB/s for a
-  per-block Python loop);
+  whole block chain in OpenSSL C (~60 MB/s measured end-to-end vs
+  ~15 MB/s for a per-block Python loop);
 * integrity: encrypt-then-MAC with HMAC-SHA256 over header ‖ ciphertext —
   tampering or a wrong key fails loudly BEFORE any unpickling happens,
   which also keeps `load_encrypted` safe against pickle-bomb swaps.
