@@ -10,6 +10,8 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import flax.linen as nn
+
+from ...ops.embedding import MXUEmbed
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -40,7 +42,7 @@ class RNNEncoder(nn.Module):
     @nn.compact
     def __call__(self, x):
         if self.vocab_size:
-            x = nn.Embed(self.vocab_size, self.embed_dim or self.hidden_size,
+            x = MXUEmbed(self.vocab_size, self.embed_dim or self.hidden_size,
                          name="embedding")(x.astype(jnp.int32))
         carries = []
         h = x
@@ -64,7 +66,7 @@ class RNNDecoder(nn.Module):
     @nn.compact
     def __call__(self, y, init_carries):
         if self.vocab_size:
-            y = nn.Embed(self.vocab_size, self.embed_dim or self.hidden_size,
+            y = MXUEmbed(self.vocab_size, self.embed_dim or self.hidden_size,
                          name="embedding")(y.astype(jnp.int32))
         h = y
         for i in range(self.nlayers):

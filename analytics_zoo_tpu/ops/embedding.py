@@ -34,16 +34,21 @@ pyzoo/zoo/models/recommendation/neuralcf.py:30-99).
 from __future__ import annotations
 
 import functools
+import os
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Crossover heuristic: per-row matmul cost is 2*B*cols FLOPs; scatter cost is
 # per-row serialization. On v5e the matmul wins by >2x at 6k rows and is
 # still ahead at 32k for embed widths <= 256; beyond that the FLOP bill
-# (linear in rows) takes over.
+# (linear in rows*cols) takes over — so "auto" gates on the table ELEMENT
+# count, not rows alone (a BERT-base token table, 30k x 768, must stay on
+# scatter even though its row count alone would pass).
 ONEHOT_ROWS_MAX = 32768
+ONEHOT_ELEMENTS_MAX = ONEHOT_ROWS_MAX * 256
 
 
 @functools.lru_cache(maxsize=None)
@@ -78,15 +83,26 @@ def embedding_lookup(table: jax.Array, ids: jax.Array, *,
     """``table[ids]`` with a TPU-tuned backward.
 
     grad_mode:
-      * ``"auto"``    — one-hot-matmul backward while ``table.shape[0] <=
-        onehot_rows_max``, else XLA's scatter-add (large vocabularies).
+      * ``"auto"``    — one-hot-matmul backward while the table is small
+        (rows <= ``onehot_rows_max`` AND rows*cols <=
+        ``ONEHOT_ELEMENTS_MAX``), else XLA's scatter-add (large
+        vocabularies / wide tables).
       * ``"onehot"``  — always the matmul backward.
-      * ``"scatter"`` — always the default scatter-add backward.
+      * ``"scatter"`` — always the default scatter-add backward (also the
+        exact-f32-gradient path).
+
+    The env var ``ZOO_EMBED_GRAD_MODE`` overrides ``"auto"`` globally
+    (escape hatch for models built through the keras/torch bridges, which
+    construct their embedding layers without a grad_mode parameter).
     """
+    if grad_mode == "auto":
+        grad_mode = os.environ.get("ZOO_EMBED_GRAD_MODE", "auto")
     if grad_mode not in ("auto", "onehot", "scatter"):
         raise ValueError(f"unknown grad_mode {grad_mode!r}")
+    rows, cols = table.shape[0], int(np.prod(table.shape[1:]))
     use_onehot = (grad_mode == "onehot" or
-                  (grad_mode == "auto" and table.shape[0] <= onehot_rows_max))
+                  (grad_mode == "auto" and rows <= onehot_rows_max
+                   and rows * cols <= ONEHOT_ELEMENTS_MAX))
     if use_onehot:
         return _make_onehot_lookup(table.shape[0],
                                    jnp.dtype(table.dtype).name)(table, ids)

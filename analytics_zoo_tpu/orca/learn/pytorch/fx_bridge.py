@@ -362,6 +362,7 @@ def build_flax_from_torch_fx(module):
                     "(values would silently differ)")
 
     import flax.linen as fnn
+    from ....ops.embedding import MXUEmbed
     import jax.numpy as jnp
 
     fn_table = _build_function_table()
@@ -476,7 +477,7 @@ def build_flax_from_torch_fx(module):
                                      use_bias=affine and sub.bias is not None,
                                      name=nm)(x)
             if isinstance(sub, tnn.Embedding):
-                return fnn.Embed(sub.num_embeddings, sub.embedding_dim,
+                return MXUEmbed(sub.num_embeddings, sub.embedding_dim,
                                  name=nm)(x.astype(jnp.int32))
             if isinstance(sub, tnn.Dropout):
                 return fnn.Dropout(rate=sub.p, deterministic=not train,

@@ -128,6 +128,7 @@ def build_flax_from_torch(module):
     custom ``forward()`` falls through to the torch.fx graph tracer
     (fx_bridge.py), which handles residuals/concats/reshapes generally."""
     import flax.linen as fnn
+    from ....ops.embedding import MXUEmbed
     import jax.numpy as jnp
 
     try:
@@ -163,7 +164,7 @@ def build_flax_from_torch(module):
                 elif k == "layernorm":
                     x = fnn.LayerNorm(epsilon=s["eps"], name=nm)(x)
                 elif k == "embedding":
-                    x = fnn.Embed(s["num"], s["dim"], name=nm)(
+                    x = MXUEmbed(s["num"], s["dim"], name=nm)(
                         x.astype(jnp.int32))
                 elif k == "dropout":
                     x = fnn.Dropout(rate=s["rate"], deterministic=not train,

@@ -191,6 +191,7 @@ def _run_spec(s: Dict[str, Any], xs: list, nm: str, train: bool):
     """Apply one layer spec to its inputs. Must be called from inside a
     flax compact __call__ (submodules register against the caller)."""
     import flax.linen as fnn
+    from ....ops.embedding import MXUEmbed
     import jax.numpy as jnp
 
     k = s["kind"]
@@ -272,7 +273,7 @@ def _run_spec(s: Dict[str, Any], xs: list, nm: str, train: bool):
     if k == "globalavgpool":
         return x.mean(axis=(1, 2), keepdims=s.get("keepdims", False))
     if k == "embedding":
-        return fnn.Embed(s["num"], s["dim"], name=nm)(x.astype(jnp.int32))
+        return MXUEmbed(s["num"], s["dim"], name=nm)(x.astype(jnp.int32))
     if k == "act":
         return _apply_act(x, s["fn"])
     raise KerasConversionError(f"unhandled spec kind {k}")

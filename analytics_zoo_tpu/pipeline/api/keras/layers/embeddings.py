@@ -10,6 +10,8 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+
+from .....ops.embedding import embedding_lookup
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,7 +44,7 @@ class Embedding(nn.Module):
         idx = x.astype(jnp.int32)
         if not self.zero_based_id:
             idx = idx - 1
-        out = jnp.take(table, jnp.clip(idx, 0, self.input_dim - 1), axis=0)
+        out = embedding_lookup(table, jnp.clip(idx, 0, self.input_dim - 1))
         if not self.trainable:
             out = jax.lax.stop_gradient(out)
         return out

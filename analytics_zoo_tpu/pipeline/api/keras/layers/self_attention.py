@@ -12,6 +12,8 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+
+from .....ops.embedding import MXUEmbed
 import jax
 import jax.numpy as jnp
 
@@ -113,7 +115,7 @@ class TransformerLayer(nn.Module):
     @nn.compact
     def __call__(self, ids, train: bool = False):
         hs = self.hidden_size
-        tok = nn.Embed(self.vocab, hs, name="token_embedding")(
+        tok = MXUEmbed(self.vocab, hs, name="token_embedding")(
             ids.astype(jnp.int32))
         pos = self.param("position_embedding",
                          nn.initializers.normal(0.02), (self.seq_len, hs))
@@ -150,10 +152,10 @@ class BERT(nn.Module):
                  train: bool = False):
         hs = self.hidden_size
         ids = ids.astype(jnp.int32)
-        tok = nn.Embed(self.vocab, hs, name="token_embedding")(ids)
+        tok = MXUEmbed(self.vocab, hs, name="token_embedding")(ids)
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(ids)
-        seg = nn.Embed(2, hs, name="segment_embedding")(
+        seg = MXUEmbed(2, hs, name="segment_embedding")(
             token_type_ids.astype(jnp.int32))
         pos = self.param("position_embedding",
                          nn.initializers.normal(0.02), (self.seq_len, hs))

@@ -23,6 +23,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 import flax.linen as nn
+
+from ...ops.embedding import MXUEmbed
 import jax
 import jax.numpy as jnp
 
@@ -61,10 +63,10 @@ class _TaggerNet(nn.Module):
 
     @nn.compact
     def __call__(self, word_ids, char_ids=None, train: bool = False):
-        h = nn.Embed(self.vocab_size, self.word_emb_dim,
+        h = MXUEmbed(self.vocab_size, self.word_emb_dim,
                      name="word_embedding")(word_ids.astype(jnp.int32))
         if char_ids is not None and self.char_vocab_size:
-            c = nn.Embed(self.char_vocab_size, self.char_emb_dim,
+            c = MXUEmbed(self.char_vocab_size, self.char_emb_dim,
                          name="char_embedding")(char_ids.astype(jnp.int32))
             # char-CNN per word: conv over the char axis, max-pool
             b, s, w, d = c.shape
@@ -131,7 +133,7 @@ class _IntentEntityNet(nn.Module):
 
     @nn.compact
     def __call__(self, word_ids, train: bool = False):
-        h = nn.Embed(self.vocab_size, self.word_emb_dim,
+        h = MXUEmbed(self.vocab_size, self.word_emb_dim,
                      name="word_embedding")(word_ids.astype(jnp.int32))
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         h = _BiLSTM(self.lstm_units, name="bilstm")(h)

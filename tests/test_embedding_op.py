@@ -63,6 +63,10 @@ def test_auto_gates_on_vocab_size():
     assert "dot_general" in bwd_ops(small, "auto")
     big = jnp.zeros((ONEHOT_ROWS_MAX + 1, 4), jnp.float32)
     assert "scatter" in bwd_ops(big, "auto")
+    # wide tables fall back too even with few rows (BERT-base shape: the
+    # one-hot FLOP bill scales with rows*cols)
+    wide = jnp.zeros((30522, 768), jnp.float32)
+    assert "scatter" in bwd_ops(wide, "auto")
 
 
 def test_mxu_embed_param_compatible_with_nn_embed():
