@@ -35,3 +35,7 @@ for script in \
   python "$script" --smoke
 done
 echo "all example smoke tests passed"
+
+echo "=== apps/ notebook corpus (cell-by-cell)"
+python apps/run_app_notebooks.py
+echo "all app notebooks passed"
