@@ -88,9 +88,11 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     per K block — peak residuals O(Sq * D * Sk / block_k), an
     ~(block_k / D)x reduction vs materialized f32 scores (8x at D=64,
     block_k=512), not fully linear. For truly linear-in-S training memory
-    shard the sequence instead (parallel/ring_attention.py). This is the
-    backward path behind ``flash_attention`` (the Pallas kernel handles
-    the forward; autodiff through it would need a transpose kernel)."""
+    shard the sequence instead (parallel/ring_attention.py). Historical
+    note: this was the flash backward through round 3; round 4 replaced it
+    with dedicated Pallas dQ/dKV kernels (``_flash_bwd``) whose tiles stay
+    in VMEM — blockwise_attention remains as the ring-attention building
+    block and a host-portable exact-attention fallback."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     b, s_q, h, d = q.shape
