@@ -176,12 +176,29 @@ class TPUEstimator:
             # guarantee a restore point exists before the first step
             self.save_checkpoint(self.model_dir)
 
+        import contextlib
+
+        from .preemption import PreemptionWatcher
+
         epoch_stats = []
+        watcher = PreemptionWatcher() if can_recover else None
+        with (watcher if watcher is not None else contextlib.nullcontext()):
+            return self._fit_loop(it, epochs, steps_per_epoch, batch_size,
+                                  feature_cols, label_cols, validation_data,
+                                  checkpoint_trigger, profile, verbose,
+                                  can_recover, retries_left, epoch_stats,
+                                  watcher)
+
+    def _fit_loop(self, it, epochs, steps_per_epoch, batch_size,
+                  feature_cols, label_cols, validation_data,
+                  checkpoint_trigger, profile, verbose, can_recover,
+                  retries_left, epoch_stats, watcher):
         ep = 0
         while ep < epochs:
             try:
                 stats = self._fit_epoch(it, ep, steps_per_epoch,
-                                        checkpoint_trigger, profile)
+                                        checkpoint_trigger, profile,
+                                        watcher)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
@@ -197,6 +214,20 @@ class TPUEstimator:
                 self.load_checkpoint(self.model_dir)
                 self._trainer_state.iteration = self.engine.step
                 continue                 # re-run the failed epoch
+            if watcher is not None and watcher.triggered:
+                # preemption notice (SIGTERM on spot/preemptible TPU VMs):
+                # checkpoint IMMEDIATELY — the grace window is short, and
+                # validation/logging must not stand between the notice and
+                # the restore point. The epoch is partial; flag it so
+                # consumers don't read its stats as a full epoch.
+                self.save_checkpoint(self.model_dir)
+                stats["preempted"] = True
+                stats["partial_epoch"] = True
+                epoch_stats.append(stats)
+                logger.warning(
+                    "stopping after a preemption notice "
+                    "(checkpointed at step %d)", self.engine.step)
+                break
             if validation_data is not None:
                 val = self.evaluate(validation_data, batch_size=batch_size,
                                     feature_cols=feature_cols,
@@ -220,7 +251,8 @@ class TPUEstimator:
         return epoch_stats
 
     def _fit_epoch(self, it, ep: int, steps_per_epoch: Optional[int],
-                   checkpoint_trigger, profile) -> Dict[str, float]:
+                   checkpoint_trigger, profile,
+                   watcher=None) -> Dict[str, float]:
         """One epoch of the hot loop; raises through to fit()'s retry."""
         t0 = time.time()
         losses = []
@@ -255,6 +287,8 @@ class TPUEstimator:
                     self._trainer_state.epoch_finished = False
                     if checkpoint_trigger(self._trainer_state):
                         self.save_checkpoint(self.model_dir)
+                if watcher is not None and watcher.triggered:
+                    break        # preemption: end the epoch at this step
         finally:
             if tracing:
                 jax.profiler.stop_trace()
