@@ -1,3 +1,5 @@
-from .caffe_loader import (CaffeLoader, load_caffe_weights, parse_caffemodel)
+from .caffe_loader import CaffeLoader, load_caffe_weights, parse_caffemodel
+from .prototxt import CaffeNet, load_caffe, parse_prototxt
 
-__all__ = ["CaffeLoader", "parse_caffemodel", "load_caffe_weights"]
+__all__ = ["CaffeLoader", "parse_caffemodel", "load_caffe_weights",
+           "CaffeNet", "load_caffe", "parse_prototxt"]
