@@ -1,8 +1,11 @@
 from .mesh import (batch_divisor, create_mesh, data_sharding,
                    mesh_axis_size, replicated, resolve_axis_sizes)
+from .pipeline_parallel import (pipeline_apply, stack_stage_params,
+                                stage_sharding)
 from .tensor_parallel import (TPDense, TPMLP, TPSelfAttention,
                               TPTransformerBlock)
 
 __all__ = ["create_mesh", "data_sharding", "replicated", "resolve_axis_sizes",
            "mesh_axis_size", "batch_divisor", "TPDense", "TPMLP",
-           "TPSelfAttention", "TPTransformerBlock"]
+           "TPSelfAttention", "TPTransformerBlock", "pipeline_apply",
+           "stack_stage_params", "stage_sharding"]

@@ -1,0 +1,120 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+Beyond-parity axis (the reference scales only in the batch dimension,
+SURVEY §2.3): a stack of S homogeneous stages (e.g. transformer blocks)
+is sharded one-stage-per-pp-rank, the batch is split into M microbatches,
+and activations flow stage→stage over ICI via ``ppermute`` inside a
+``lax.scan`` of M + S - 1 ticks (the classic GPipe schedule; bubble
+fraction (S-1)/(M+S-1)). Everything is differentiable — ``ppermute``'s
+transpose is the reverse rotation — so one ``jax.grad`` over the pipelined
+forward trains all stages.
+
+Functional surface (flax-module-agnostic):
+
+    stacked = stack_stage_params([init_stage(rng_i) for i in range(S)])
+    y = pipeline_apply(stage_fn, stacked, x, mesh=mesh, microbatches=M)
+
+``stage_fn(params_one_stage, x_mb) -> y_mb`` must be shape-preserving in
+the batch dims (the pipeline carries a single activation buffer).
+``stacked`` has a leading stage axis sharded over ``pp``; everything else
+(input, output) is replicated across ``pp`` and may be sharded over
+``dp``/``tp`` by the caller's outer machinery as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map               # jax >= 0.8 (check_vma kwarg)
+
+
+def stack_stage_params(per_stage: List[Any]) -> Any:
+    """[stage_pytree, ...] -> one pytree with a leading stage axis."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_stage)
+
+
+def stage_sharding(mesh: Mesh, stacked: Any, axis: str = "pp") -> Any:
+    """NamedShardings placing the leading stage axis on ``axis``."""
+    def shard(leaf):
+        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        return NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(shard, stacked)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jax.Array,
+                   *, mesh: Mesh, microbatches: int,
+                   axis: str = "pp") -> jax.Array:
+    """Run ``x`` through S pipelined stages; returns the final stage's
+    output, replicated across the ``pp`` axis.
+
+    x: (B, ...) with B % microbatches == 0. Stage count S = mesh.shape
+    [axis]; the stacked params' leading axis must equal S.
+    """
+    s_count = mesh.shape[axis]
+    leading = {l.shape[0] for l in jax.tree_util.tree_leaves(stacked_params)}
+    if leading != {s_count}:
+        raise ValueError(
+            f"stacked params' leading stage axis {sorted(leading)} must "
+            f"equal the '{axis}' mesh axis size {s_count} — shard_map "
+            "would otherwise silently slice away stages")
+    b = x.shape[0]
+    if b % microbatches:
+        raise ValueError(f"batch {b} not divisible by microbatches "
+                         f"{microbatches}")
+    mb = b // microbatches
+    xs = x.reshape(microbatches, mb, *x.shape[1:])
+
+    def pp_body(params, xs_local):
+        # params: this rank's stage slice, leading axis 1 -> squeeze
+        params = jax.tree_util.tree_map(lambda l: l[0], params)
+        rank = lax.axis_index(axis)
+        ticks = microbatches + s_count - 1
+        zero = jnp.zeros_like(xs_local[0])
+
+        def tick(carry, t):
+            recv, outs = carry
+            # stage 0 injects microbatch t (while t < M); later stages
+            # consume what the previous stage sent last tick
+            feed_idx = jnp.minimum(t, microbatches - 1)
+            inject = lax.dynamic_index_in_dim(xs_local, feed_idx, 0,
+                                              keepdims=False)
+            inp = jnp.where(rank == 0,
+                            jnp.where(t < microbatches, inject, zero),
+                            recv)
+            out = stage_fn(params, inp)
+            # rotate activations one stage forward
+            perm = [(i, (i + 1) % s_count) for i in range(s_count)]
+            recv_next = lax.ppermute(out, axis, perm)
+            # last stage banks microbatch t-(S-1) when it's live
+            out_idx = t - (s_count - 1)
+            live = jnp.logical_and(rank == s_count - 1, out_idx >= 0)
+            outs = lax.cond(
+                live,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, out, jnp.maximum(out_idx, 0), 0),
+                lambda o: o, outs)
+            return (recv_next, outs), None
+
+        init = (zero, jnp.zeros_like(xs_local))
+        (_, outs), _ = lax.scan(tick, init, jnp.arange(ticks))
+        # replicate the last stage's banked outputs across pp: every other
+        # rank holds zeros, so a psum broadcasts without a gather
+        mask = jnp.where(lax.axis_index(axis) == s_count - 1, 1.0, 0.0)
+        return lax.psum(outs * mask.astype(outs.dtype), axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda l: P(axis, *([None] * (l.ndim - 1))), stacked_params)
+    # activations are replicated across pp (P()); dp/tp sharding of the
+    # batch composes at the caller's jit level as usual
+    fn = shard_map(
+        pp_body, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)
+    outs = fn(stacked_params, xs)
+    return outs.reshape(b, *x.shape[1:])
