@@ -1,0 +1,129 @@
+"""Expert parallelism: Switch-style top-1 MoE with all-to-all dispatch
+over an ``ep`` mesh axis.
+
+Beyond-parity axis (the reference is data-parallel only, SURVEY §2.3).
+The GShard/Switch recipe, TPU-native: tokens are data-sharded over
+``ep``; a replicated router picks one expert per token; each rank packs
+its tokens into an (E, C, d) capacity buffer, one ``lax.all_to_all``
+rotates expert-major buffers so each rank receives exactly the tokens
+routed to ITS expert, the local expert FFN runs on them, and a second
+``all_to_all`` returns outputs to their source ranks where the gate
+probability scales them. Tokens beyond an expert's capacity C are
+dropped (standard Switch behaviour) — with ``capacity_factor`` high
+enough nothing drops and the layer equals the dense
+gather-per-token-through-its-expert computation exactly
+(tests/test_expert_parallel.py).
+
+Everything is differentiable: the router trains through the gate
+scaling, experts through the dispatched tokens; the Switch load-balance
+auxiliary loss is returned alongside the output.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map                # jax >= 0.8
+
+
+def stack_expert_params(per_expert) -> Any:
+    """[expert_pytree, ...] -> one pytree with a leading expert axis."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_expert)
+
+
+def expert_sharding(mesh: Mesh, stacked: Any, axis: str = "ep") -> Any:
+    return jax.tree_util.tree_map(
+        lambda l: NamedSharding(mesh, P(axis, *([None] * (l.ndim - 1)))),
+        stacked)
+
+
+def moe_apply(expert_fn: Callable, expert_params: Any,
+              router_weights: jax.Array, x: jax.Array, *, mesh: Mesh,
+              capacity_factor: float = 1.25,
+              axis: str = "ep") -> Tuple[jax.Array, jax.Array]:
+    """Top-1 (Switch) mixture of experts.
+
+    expert_fn(params_one_expert, tokens) -> tokens (shape-preserving);
+    expert_params: stacked with leading axis E == mesh.shape[axis];
+    router_weights: (d, E), replicated; x: (N, d) with N % ep == 0,
+    sharded (or shardable) over ``axis`` on dim 0.
+
+    Returns (y, aux_loss): y (N, d); aux_loss is the Switch load-balance
+    term (E * sum_e fraction_e * mean_prob_e), which is 1.0 at perfect
+    balance — add ``alpha * aux_loss`` to the training loss.
+    """
+    e_count = mesh.shape[axis]
+    leading = {l.shape[0]
+               for l in jax.tree_util.tree_leaves(expert_params)}
+    if leading != {e_count}:
+        raise ValueError(
+            f"stacked expert params' leading axis {sorted(leading)} must "
+            f"equal the '{axis}' mesh axis size {e_count}")
+    if router_weights.shape[-1] != e_count:
+        raise ValueError(
+            f"router_weights last dim {router_weights.shape[-1]} must "
+            f"equal the '{axis}' mesh axis size {e_count} (one logit per "
+            "expert)")
+    n, d = x.shape
+    if n % e_count:
+        raise ValueError(f"token count {n} not divisible by ep={e_count}")
+    local_n = n // e_count
+    capacity = max(1, int(math.ceil(
+        capacity_factor * local_n / e_count)))
+
+    def ep_body(params, router_w, x_local):
+        params = jax.tree_util.tree_map(lambda l: l[0], params)
+
+        logits = x_local @ router_w                     # (ln, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)         # (ln,)
+        gate = jnp.take_along_axis(probs, expert_idx[:, None],
+                                   axis=-1)[:, 0]       # (ln,)
+
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(expert_idx, e_count, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot       # 1-based ranks
+        pos = jnp.sum(pos, axis=-1) - 1                 # (ln,) 0-based
+        keep = pos < capacity                           # overflow drops
+
+        # scatter tokens into the (E, C, d) dispatch buffer
+        buf = jnp.zeros((e_count, capacity, d), x_local.dtype)
+        buf = buf.at[expert_idx, jnp.clip(pos, 0, capacity - 1)].add(
+            jnp.where(keep[:, None], x_local, 0.0))
+
+        # exchange: expert-major -> source-rank-major on the owning rank
+        recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                              tiled=True)               # (ep*C, d) groups
+        recv = recv.reshape(e_count * capacity, d)
+        out = expert_fn(params, recv)                   # local expert
+        out = out.reshape(e_count, capacity, d)
+        back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                              tiled=True)               # (E, C, d) home
+
+        # gather each surviving token's output; dropped tokens pass
+        # through as zeros (standard Switch residual handles them)
+        y = back[expert_idx, jnp.clip(pos, 0, capacity - 1)]
+        y = jnp.where(keep[:, None], y * gate[:, None], 0.0)
+
+        # Switch load-balance aux: fraction of tokens per expert x mean
+        # router prob per expert, both averaged GLOBALLY over ep
+        frac = lax.pmean(jnp.mean(
+            jax.nn.one_hot(expert_idx, e_count, dtype=x_local.dtype),
+            axis=0), axis)
+        mean_p = lax.pmean(jnp.mean(probs, axis=0), axis)
+        aux = e_count * jnp.sum(frac * mean_p)
+        return y, aux
+
+    param_specs = jax.tree_util.tree_map(
+        lambda l: P(axis, *([None] * (l.ndim - 1))), expert_params)
+    fn = shard_map(ep_body, mesh=mesh,
+                   in_specs=(param_specs, P(), P(axis)),
+                   out_specs=(P(axis), P()),
+                   check_vma=False)
+    y, aux = fn(expert_params, router_weights, x)
+    return y, aux

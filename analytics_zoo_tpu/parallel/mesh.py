@@ -25,17 +25,27 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_ORDER = ("dp", "fsdp", "tp", "sp")
+# optional axes appended to the mesh only when requested: pipeline stages
+# (parallel/pipeline_parallel.py) and MoE experts (expert_parallel.py)
+OPTIONAL_AXES = ("pp", "ep")
 
 
 def resolve_axis_sizes(n_devices: int, axes: Dict[str, int]) -> Dict[str, int]:
     """Resolve ``-1`` wildcards so that the product of axis sizes == n_devices.
 
-    At most one axis may be -1. Missing canonical axes get size 1.
+    At most one axis may be -1. Missing canonical axes get size 1;
+    unknown axis names raise (a silently-dropped axis previously crashed
+    later with an opaque reshape error).
     """
+    unknown = set(axes) - set(AXIS_ORDER) - set(OPTIONAL_AXES)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)} — known: "
+            f"{AXIS_ORDER + OPTIONAL_AXES}")
     sizes = {a: int(axes.get(a, 1)) for a in AXIS_ORDER}
-    for a, v in axes.items():
-        if a not in sizes:
-            sizes[a] = int(v)
+    for a in OPTIONAL_AXES:
+        if a in axes:
+            sizes[a] = int(axes[a])
     wild = [a for a, v in sizes.items() if v == -1]
     if len(wild) > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {wild}")
@@ -62,9 +72,10 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
     """
     devices = list(devices if devices is not None else jax.devices())
     sizes = resolve_axis_sizes(len(devices), axes or {"dp": -1})
-    # drop trailing size-1 axes? No — keep all four so PartitionSpecs are stable.
-    shape = tuple(sizes[a] for a in AXIS_ORDER)
-    names = AXIS_ORDER
+    # drop trailing size-1 axes? No — keep all four so PartitionSpecs are
+    # stable; optional pp/ep axes append only when requested
+    names = AXIS_ORDER + tuple(a for a in OPTIONAL_AXES if a in sizes)
+    shape = tuple(sizes[a] for a in names)
     try:
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)

@@ -27,7 +27,6 @@ from typing import Any, Callable, List
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map               # jax >= 0.8 (check_vma kwarg)

@@ -94,3 +94,17 @@ def test_pipeline_rejects_stage_count_mismatch():
     with pytest.raises(ValueError, match="stage axis"):
         pipeline_apply(_stage_fn, stacked, jnp.zeros((8, 4), jnp.float32),
                        mesh=mesh, microbatches=4)
+
+
+def test_create_mesh_supports_optional_pp_ep_axes():
+    """The documented mesh-building path must build pp/ep meshes and
+    reject unknown axis names loudly (round-4 review finding)."""
+    from analytics_zoo_tpu.parallel import create_mesh
+
+    mesh = create_mesh({"dp": 2, "pp": 4})
+    assert mesh.shape["pp"] == 4 and mesh.shape["dp"] == 2
+    mesh2 = create_mesh({"ep": 4, "dp": -1})
+    assert mesh2.shape["ep"] == 4
+    import pytest
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        create_mesh({"zz": 2, "dp": -1})
