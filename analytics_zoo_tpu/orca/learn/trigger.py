@@ -44,13 +44,29 @@ class EveryEpoch(Trigger):
 
 
 class SeveralIteration(Trigger):
-    """Fires every N iterations (reference: trigger.py:59)."""
+    """Fires every N iterations (reference: trigger.py:59).
+
+    Implemented as an interval-bucket edge detector rather than a bare
+    ``iteration % N == 0`` so it still fires when the trainer checks the
+    trigger every k steps (the scan-fused dispatch loop advances iteration
+    in groups): any check that crosses one or more N-boundaries fires once.
+    """
 
     def __init__(self, interval: int):
         self.interval = int(interval)
+        self._last_bucket = 0
 
     def __call__(self, state):
-        return state.iteration > 0 and state.iteration % self.interval == 0
+        bucket = state.iteration // self.interval
+        if bucket < self._last_bucket:
+            # iteration went backwards: the trigger object is being reused
+            # for a new run (or a restore rewound the counter) — resync so
+            # it keeps firing instead of staying dark until the old mark
+            self._last_bucket = bucket
+        if state.iteration > 0 and bucket > self._last_bucket:
+            self._last_bucket = bucket
+            return True
+        return False
 
 
 class MaxEpoch(Trigger):

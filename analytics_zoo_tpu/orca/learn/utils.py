@@ -35,6 +35,9 @@ class Batch:
     # (batch,) 1.0 for real rows, 0.0 for padding; None == all ones (the
     # jitted step synthesizes them on device — no transfer for full batches)
     w: Optional[np.ndarray]
+    # >1: arrays are stacked (fused, batch, ...) superbatches for the
+    # engine's scan-fused multi-step path (train_batch_group)
+    fused: int = 1
 
 
 def _as_tuple(v) -> Tuple:
@@ -140,6 +143,81 @@ def concat_shards(shards: HostXShards) -> Dict[str, Tuple[np.ndarray, ...]]:
     return out
 
 
+# peak dense bf16 FLOP/s per jax device (public TPU specs; v2/v3 devices
+# are cores, v4+ devices are chips). Longest key wins so "v5p" beats "v5".
+_PEAK_BF16 = {"v6": 918e12, "v5p": 459e12, "v5": 197e12, "v4": 275e12,
+              "v3": 61.5e12, "v2": 23e12}
+_PEAK_ORDER = sorted(_PEAK_BF16.items(), key=lambda kv: -len(kv[0]))
+
+# typical training MFU assumed when converting cost-analysis FLOPs to a
+# compute-time estimate (shared by the fuse gate and bench.py)
+ASSUMED_TRAIN_MFU = 0.3
+
+
+def peak_bf16_flops(device) -> float:
+    """Peak dense bf16 FLOP/s of a jax device, 0.0 if unknown (CPU)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_ORDER:
+        if key in kind:
+            return val
+    return 0.0
+
+
+def estimate_step_compute_s(jitted, args, devices) -> Optional[float]:
+    """Analytic per-step compute-time estimate: XLA's own cost-analysis
+    FLOPs for the compiled step, divided by ASSUMED_TRAIN_MFU of the devices peak bf16
+    rate (a typical training MFU). Used to decide whether a step is
+    compute-dominated INDEPENDENT of wall-clock measurements, which on a
+    shared/tunneled chip conflate dispatch overhead and contention with
+    compute. Returns None when FLOPs or peak are unknown (e.g. CPU)."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        peak = sum(peak_bf16_flops(d) for d in devices)
+        if flops > 0 and peak > 0:
+            return flops / (ASSUMED_TRAIN_MFU * peak)
+    except Exception:
+        pass
+    return None
+
+
+def auto_fuse_factor(step_time_s: float, steps_per_epoch: int,
+                     batch_bytes: int = 0,
+                     compute_s: Optional[float] = None,
+                     target_s: float = 0.25, max_fuse: int = 128,
+                     max_group_bytes: int = 256 << 20) -> int:
+    """How many train steps to fuse into one dispatch (lax.scan group).
+
+    ``step_time_s`` is the pipelined per-step wall time of the dispatched
+    train step — measure it as min-of-several runs of (m non-blocking calls
+    + one fetch)/m, so contention spikes and the tail round trip wash out.
+    ``compute_s`` is the analytic estimate from ``estimate_step_compute_s``;
+    when available it decides the compute-dominated gate (≥10 ms → stay
+    unfused: per-step triggers and infeed granularity are worth more than
+    the <2% dispatch saving), so a contended or high-latency chip can't
+    masquerade as a big model. k is then sized so one fused group runs
+    ~``target_s``: if the measured time was mostly per-call dispatch
+    overhead, that overhead shrinks k-fold; if it was mostly compute, the
+    group just batches ~target_s of work. Either way the host leaves the
+    hot path. ``batch_bytes`` caps k so a stacked superbatch stays under
+    ``max_group_bytes``.
+    """
+    if steps_per_epoch < 2:
+        return 1
+    gate = compute_s if compute_s is not None else step_time_s
+    if gate >= 0.01:
+        return 1
+    k = int(target_s / max(step_time_s, 1e-5))
+    if k <= 1:
+        return 1
+    k = 1 << (k - 1).bit_length()           # round UP to a power of two
+    if batch_bytes > 0:
+        k = min(k, max(max_group_bytes // batch_bytes, 1))
+    return max(1, min(k, max_fuse, steps_per_epoch))
+
+
 class BatchIterator:
     """Epoch iterator over host-local data producing padded global batches.
 
@@ -148,6 +226,8 @@ class BatchIterator:
     batch semantics, tf_dataset.py:135-149), so each host contributes
     batch_size / process_count rows per step.
     """
+
+    supports_fused = True       # capability flag: epoch(fuse=k) is available
 
     def __init__(self, data: Dict[str, Tuple[np.ndarray, ...]],
                  batch_size: int, mesh: Mesh, shuffle: bool = False,
@@ -187,21 +267,33 @@ class BatchIterator:
         self._epoch = 0
         self._sharding_cache: Dict[int, NamedSharding] = {}
 
-    def _sharding(self, ndim: int) -> NamedSharding:
-        if ndim not in self._sharding_cache:
-            spec = (("dp", "fsdp"),) + (None,) * (ndim - 1)
-            self._sharding_cache[ndim] = NamedSharding(self.mesh, P(*spec))
-        return self._sharding_cache[ndim]
+    def _sharding(self, ndim: int, fused: bool = False) -> NamedSharding:
+        key = (ndim, fused)
+        if key not in self._sharding_cache:
+            # fused superbatches carry a leading scan axis that must stay
+            # unsharded; the batch axis (0 or 1) gets the data axes
+            lead = (None,) if fused else ()
+            spec = lead + (("dp", "fsdp"),) + (None,) * (ndim - len(lead) - 1)
+            self._sharding_cache[key] = NamedSharding(self.mesh, P(*spec))
+        return self._sharding_cache[key]
 
-    def _device_put(self, arr: np.ndarray):
-        sh = self._sharding(arr.ndim)
+    def _device_put(self, arr: np.ndarray, fused: bool = False):
+        sh = self._sharding(arr.ndim, fused)
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sh, arr)
         return jax.device_put(arr, sh)
 
-    def _host_batches(self, shuffle: bool) -> Iterator[Batch]:
+    def _host_batches(self, shuffle: bool, fuse: int = 1) -> Iterator[Batch]:
         """Assemble host-side batches: native shuffled index generation and
-        threaded row-gather (analytics_zoo_tpu.native), both off the GIL."""
+        threaded row-gather (analytics_zoo_tpu.native), both off the GIL.
+
+        ``fuse`` > 1 groups that many consecutive FULL batches into ONE
+        stacked superbatch (leaves ``(fuse, local_bs, ...)``) for the
+        engine's scan-fused multi-step dispatch. The ragged tail falls back
+        to ordinary single batches (last one padded + masked) — padding a
+        whole superbatch would synthesize fully-empty steps whose zero-grad
+        optimizer updates are NOT no-ops under momentum/Adam.
+        """
         from analytics_zoo_tpu.native import gather_rows, shuffled_indices
         if shuffle:
             order = shuffled_indices(self.n, seed=self.seed + self._epoch)
@@ -211,8 +303,26 @@ class BatchIterator:
         xs_src = tuple(np.asarray(a) for a in self.x)
         ys_src = (tuple(np.asarray(a) for a in self.y)
                   if self.y is not None else None)
-        for s in range(self.steps_per_epoch):
-            idx = order[s * self.local_bs:(s + 1) * self.local_bs]
+        group = self.local_bs * max(fuse, 1)
+        n_groups = self.n // group if fuse > 1 else 0
+        for s in range(n_groups):
+            idx = order[s * group:(s + 1) * group]
+            xs = tuple(
+                gather_rows(a, idx).reshape((fuse, self.local_bs)
+                                            + a.shape[1:]) for a in xs_src)
+            ys = (tuple(
+                gather_rows(a, idx).reshape((fuse, self.local_bs)
+                                            + a.shape[1:]) for a in ys_src)
+                if ys_src is not None else None)
+            yield Batch(x=xs, y=ys, w=None, fused=fuse)
+        done = n_groups * group
+        tail_steps = (math.ceil((self.n - done) / self.local_bs)
+                      if self.pad_tail
+                      else (self.n - done) // self.local_bs) \
+            if fuse > 1 else self.steps_per_epoch
+        for s in range(tail_steps):
+            idx = order[done + s * self.local_bs:
+                        done + (s + 1) * self.local_bs]
             real = len(idx)
             if real < self.local_bs:
                 idx = np.concatenate(
@@ -230,24 +340,27 @@ class BatchIterator:
             yield Batch(x=xs, y=ys, w=w)
 
     def _put_batch(self, b: Batch) -> Batch:
+        fused = b.fused > 1
         return Batch(
-            x=tuple(self._device_put(a) for a in b.x),
-            y=(tuple(self._device_put(a) for a in b.y)
+            x=tuple(self._device_put(a, fused) for a in b.x),
+            y=(tuple(self._device_put(a, fused) for a in b.y)
                if b.y is not None else None),
-            w=self._device_put(b.w) if b.w is not None else None)
+            w=self._device_put(b.w, fused) if b.w is not None else None,
+            fused=b.fused)
 
     def epoch(self, shuffle: Optional[bool] = None,
-              prefetch: bool = True) -> Iterator[Batch]:
+              prefetch: bool = True, fuse: int = 1) -> Iterator[Batch]:
         """Yield device-resident batches. With prefetch, a background pump
         stages the next batch into HBM while the current step runs
-        (SURVEY.md §7 hard part #1 — infeed throughput)."""
+        (SURVEY.md §7 hard part #1 — infeed throughput). ``fuse`` > 1 yields
+        stacked superbatches for ``TrainEngine.train_batch_group``."""
         shuffle = self.shuffle if shuffle is None else shuffle
         if not prefetch:
-            for b in self._host_batches(shuffle):
+            for b in self._host_batches(shuffle, fuse):
                 yield self._put_batch(b)
             return
         from analytics_zoo_tpu.native.infeed import InfeedPump
-        yield from InfeedPump(lambda: self._host_batches(shuffle),
+        yield from InfeedPump(lambda: self._host_batches(shuffle, fuse),
                               device_put=self._put_batch, depth=2)
 
 
