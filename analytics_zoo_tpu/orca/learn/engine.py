@@ -388,6 +388,20 @@ class TrainEngine:
             self._jit_predict = jax.jit(self._predict_step)
         return self._jit_predict(self.params, self.extra_vars, x)
 
+    # --- device-side state snapshot (probe/rollback support) ----------------
+    def snapshot(self):
+        """On-device copy of the full training state. Lets a caller run real
+        train steps (e.g. the fuse-factor timing probe) and roll them back
+        exactly — the copies survive buffer donation by the probed steps.
+        Costs one transient duplicate of params+opt_state in HBM, so callers
+        should gate on model size where that matters."""
+        cp = lambda t: jax.tree.map(jnp.copy, t)  # noqa: E731
+        return (cp(self.params), cp(self.extra_vars), cp(self.opt_state),
+                self.step)
+
+    def restore_snapshot(self, snap):
+        self.params, self.extra_vars, self.opt_state, self.step = snap
+
     # --- state access -------------------------------------------------------
     def get_state(self) -> Dict[str, Any]:
         return {"params": jax.device_get(self.params),

@@ -160,6 +160,8 @@ class TPUEstimator:
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         checkpoint_trigger = (Trigger.convert_trigger(checkpoint_trigger)
                               if checkpoint_trigger else None)
+        if hasattr(checkpoint_trigger, "arm"):
+            checkpoint_trigger.arm(self._trainer_state)
         # recovery is opted into by checkpointing (a trigger) or an explicit
         # retry count; a bare model_dir (often set just to control save()
         # paths) must not start writing ckpt-* directories on its own
@@ -184,16 +186,17 @@ class TPUEstimator:
             fuse = self._choose_fuse(it, steps_per_epoch, checkpoint_trigger)
         except (KeyboardInterrupt, SystemExit):
             raise
+        except (ValueError, TypeError):
+            raise           # config/validation errors must surface
         except Exception as e:
-            # the auto-probe runs real train steps before _fit_loop's
-            # retry handler exists; keep its failures recoverable too
+            # the auto-probe dispatches real (rolled-back) train steps
+            # before _fit_loop's retry handler exists; a chip failure there
+            # must not crash a recoverable fit. The probe's finally already
+            # restored the state snapshot — just train unfused.
             if not can_recover:
                 raise
-            logger.warning(
-                "fuse probe failed (%s: %s); restoring checkpoint and "
-                "training unfused", type(e).__name__, e)
-            self.load_checkpoint(self.model_dir)
-            self._trainer_state.iteration = self.engine.step
+            logger.warning("fuse probe failed (%s: %s); training unfused",
+                           type(e).__name__, e)
             fuse = 1
         epoch_stats = []
         watcher = PreemptionWatcher() if can_recover else None
@@ -213,62 +216,92 @@ class TPUEstimator:
         ~0.25-0.5 s (``auto_fuse_factor`` target, pow2-rounded);
         big-model steps (≥10 ms) stay unfused. Set config
         ``steps_per_dispatch`` to an int to pin, or 1 to disable."""
-        cfg = self.config.get("steps_per_dispatch", "auto")
         if not getattr(it, "supports_fused", False) or \
                 steps_per_epoch is not None:
             # custom iterators (streaming pipelines) and explicit
             # steps_per_epoch keep the exact per-step loop
             return 1
+        cfg = self.config.get("steps_per_dispatch", "auto")
+        row_bytes = sum(int(np.asarray(a[:1]).nbytes)
+                        for a in tuple(it.x) + tuple(it.y or ()))
+        batch_bytes = row_bytes * it.local_bs
         if cfg != "auto":
-            k = int(cfg) if cfg else 1
-            return max(1, min(k, it.steps_per_epoch))
-        if it.steps_per_epoch < 2:
+            k = max(1, int(cfg)) if cfg else 1
+        elif it.steps_per_epoch < 2:
             return 1
+        else:
+            k = self._auto_probe_fuse(it, batch_bytes)
+        # caps shared by pinned and auto: superbatch memory, checkpoint
+        # cadence, epoch length
+        if batch_bytes > 0:
+            byte_cap = max(learn_utils.MAX_GROUP_BYTES // batch_bytes, 1)
+            if k > byte_cap:
+                logger.warning(
+                    "steps_per_dispatch %d capped to %d so a stacked "
+                    "superbatch stays under %dMB", k, byte_cap,
+                    learn_utils.MAX_GROUP_BYTES >> 20)
+                k = byte_cap
+        from .trigger import SeveralIteration
+        if isinstance(trigger, SeveralIteration):
+            # keep the exact checkpoint cadence: never fuse past the interval
+            k = min(k, trigger.interval)
+        return max(1, min(k, it.steps_per_epoch))
+
+    def _auto_probe_fuse(self, it, batch_bytes: int) -> int:
+        """Time the pipelined dispatch loop with REAL train steps, then roll
+        the engine state back to the snapshot — the probe leaves the
+        optimizer trajectory exactly as if it never ran, so auto-fused and
+        pinned runs train identically. Gated first on the analytic
+        compute estimate (cheap: the AOT lowering shares the jit executable
+        cache), so compute-dominated models skip both the probe and the
+        snapshot copy of params+opt_state."""
         import jax
+        import jax.numpy as jnp
+        eng = self.engine
+        # the probe's throwaway epoch() must not advance the iterator's
+        # shuffle-seed counter, or auto runs would see different data orders
+        # than pinned runs
+        epoch_counter = getattr(it, "_epoch", None)
         gen = it.epoch(shuffle=False, prefetch=False)
         b0 = next(gen)
-        # REAL train steps: the first compiles, the rest time the pipelined
-        # (non-blocking) dispatch loop — the thing fusion actually
-        # amortizes. They advance training (counted in trainer_state), so
-        # convergence semantics hold.
-        jax.block_until_ready(self.engine.train_batch(b0))
-        batch_bytes = sum(int(getattr(a, "nbytes", 0))
-                          for a in tuple(b0.x) + tuple(b0.y or ()))
+        if eng._jit_train is None:
+            eng._jit_train = jax.jit(eng._train_step, donate_argnums=(0, 2))
+        compute_s = learn_utils.estimate_step_compute_s(
+            eng._jit_train,
+            (eng.params, eng.extra_vars, eng.opt_state,
+             jnp.asarray(eng.step), b0.x, b0.y, b0.w),
+            list(self.mesh.devices.flat))
+        if compute_s is not None and compute_s >= 0.01:
+            return 1        # compute-dominated: nothing worth amortizing
         m = max(2, min(6, it.steps_per_epoch - 1,
                        int((64 << 20) // max(batch_bytes, 1)) or 2))
-        probe = []
+        probe = [b0]
         for _ in range(m):
             b = next(gen, None)
             if b is None:
                 break
             probe.append(b)           # device_put happens here, untimed
-        if not probe:
-            self._trainer_state.iteration += 1
-            return 1
-        dt = float("inf")
-        for _ in range(2):          # min-of-2 washes out contention spikes
-            t0 = time.perf_counter()
-            for b in probe:
-                loss = self.engine.train_batch(b)
-            jax.block_until_ready(loss)
-            dt = min(dt, (time.perf_counter() - t0) / len(probe))
-        self._trainer_state.iteration += 1 + 2 * len(probe)
-        import jax.numpy as jnp
-        compute_s = learn_utils.estimate_step_compute_s(
-            self.engine._jit_train,
-            (self.engine.params, self.engine.extra_vars,
-             self.engine.opt_state, jnp.asarray(0), b0.x, b0.y, b0.w),
-            list(self.mesh.devices.flat))
+        snap = eng.snapshot()
+        try:
+            jax.block_until_ready(eng.train_batch(b0))   # compile + warm
+            dt = float("inf")
+            for _ in range(2):      # min-of-2 washes out contention spikes
+                t0 = time.perf_counter()
+                for i in range(m):
+                    loss = eng.train_batch(probe[i % len(probe)])
+                jax.block_until_ready(loss)
+                dt = min(dt, (time.perf_counter() - t0) / m)
+        finally:
+            eng.restore_snapshot(snap)
+            gen.close()
+            if epoch_counter is not None:
+                it._epoch = epoch_counter
         k = learn_utils.auto_fuse_factor(dt, it.steps_per_epoch,
                                          batch_bytes=batch_bytes,
                                          compute_s=compute_s)
-        from .trigger import SeveralIteration
-        if isinstance(trigger, SeveralIteration):
-            # keep the exact checkpoint cadence: never fuse past the interval
-            k = max(1, min(k, trigger.interval))
         if k > 1:
             logger.info("fusing %d train steps per dispatch "
-                        "(pipelined %.2f ms/step)", k, dt * 1e3)
+                        "(pipelined probe %.2f ms/step)", k, dt * 1e3)
         return k
 
     def _fit_loop(self, it, epochs, steps_per_epoch, batch_size,

@@ -56,12 +56,18 @@ class SeveralIteration(Trigger):
         self.interval = int(interval)
         self._last_bucket = 0
 
+    def arm(self, state):
+        """Sync to the run's starting iteration (the trainer calls this at
+        fit() start): a fresh trigger on a resumed run must not fire
+        mid-interval, and a reused trigger on a fresh run must not stay
+        dark until its old mark."""
+        self._last_bucket = state.iteration // self.interval
+
     def __call__(self, state):
         bucket = state.iteration // self.interval
         if bucket < self._last_bucket:
-            # iteration went backwards: the trigger object is being reused
-            # for a new run (or a restore rewound the counter) — resync so
-            # it keeps firing instead of staying dark until the old mark
+            # iteration went backwards without re-arming (restore rewound
+            # the counter) — resync so the trigger keeps firing
             self._last_bucket = bucket
         if state.iteration > 0 and bucket > self._last_bucket:
             self._last_bucket = bucket

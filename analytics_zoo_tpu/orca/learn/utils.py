@@ -153,6 +153,10 @@ _PEAK_ORDER = sorted(_PEAK_BF16.items(), key=lambda kv: -len(kv[0]))
 # compute-time estimate (shared by the fuse gate and bench.py)
 ASSUMED_TRAIN_MFU = 0.3
 
+# ceiling for one stacked (fuse, batch, ...) superbatch — bounds HBM staging
+# and host gather granularity for the scan-fused dispatch path
+MAX_GROUP_BYTES = 256 << 20
+
 
 def peak_bf16_flops(device) -> float:
     """Peak dense bf16 FLOP/s of a jax device, 0.0 if unknown (CPU)."""
@@ -187,7 +191,7 @@ def auto_fuse_factor(step_time_s: float, steps_per_epoch: int,
                      batch_bytes: int = 0,
                      compute_s: Optional[float] = None,
                      target_s: float = 0.25, max_fuse: int = 128,
-                     max_group_bytes: int = 256 << 20) -> int:
+                     max_group_bytes: int = MAX_GROUP_BYTES) -> int:
     """How many train steps to fuse into one dispatch (lax.scan group).
 
     ``step_time_s`` is the pipelined per-step wall time of the dispatched
@@ -209,7 +213,17 @@ def auto_fuse_factor(step_time_s: float, steps_per_epoch: int,
     gate = compute_s if compute_s is not None else step_time_s
     if gate >= 0.01:
         return 1
-    k = int(target_s / max(step_time_s, 1e-5))
+    if compute_s is not None and compute_s < step_time_s:
+        # the measured step is (overhead + compute) and the analytic part
+        # says compute is the small piece. Sizing k off step_time alone is
+        # too timid exactly when overhead is worst (contended/tunneled
+        # chip); sizing off compute_s alone overshoots when the model runs
+        # below the assumed MFU. The geometric mean hedges both: group wall
+        # time lands within sqrt(step_time/compute) of target either way.
+        denom = math.sqrt(max(compute_s, 1e-6) * step_time_s)
+    else:
+        denom = max(step_time_s, 1e-5)
+    k = int(target_s / denom)
     if k <= 1:
         return 1
     k = 1 << (k - 1).bit_length()           # round UP to a power of two

@@ -215,6 +215,31 @@ def test_fused_dispatch_matches_sequential(orca_context):
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_auto_probe_rolls_back(orca_context):
+    """The 'auto' fuse probe dispatches real train steps but must roll the
+    engine back: after fit(epochs=1) the optimizer has taken exactly
+    steps_per_epoch steps and the params match a pinned-fuse run."""
+    import jax
+    x, y = make_linear_data(512)
+    est = Estimator.from_keras(linear_model_creator, loss="mse",
+                               optimizer="adam")   # default: auto
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=64, shuffle=True,
+            verbose=False)
+    assert est.engine.step == 8                    # 512/64, probe invisible
+    est2 = Estimator.from_keras(linear_model_creator, loss="mse",
+                                optimizer="adam",
+                                config={"steps_per_dispatch": 1})
+    # shuffle=True: the probe must not advance the shuffle-seed counter
+    # either, or the two runs would see different data orders
+    est2.fit({"x": x, "y": y}, epochs=1, batch_size=64, shuffle=True,
+             verbose=False)
+    for a, b in zip(jax.tree_util.tree_leaves(
+                        jax.device_get(est.engine.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(est2.engine.params))):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
 def test_fused_dispatch_ragged_tail(orca_context):
     """n not divisible by fuse*batch: full groups run fused, the remainder
     runs as single (padded+masked) batches; every sample is seen once."""
