@@ -338,9 +338,16 @@ class TrainEngine:
         return preds
 
     # --- public API ---------------------------------------------------------
-    def train_batch(self, batch: Batch) -> jnp.ndarray:
+    def ensure_jit_train(self):
+        """Build (or return) the jitted single-step executable — the one
+        place its jit options live, shared by train_batch and the
+        estimator's fuse probe."""
         if self._jit_train is None:
             self._jit_train = jax.jit(self._train_step, donate_argnums=(0, 2))
+        return self._jit_train
+
+    def train_batch(self, batch: Batch) -> jnp.ndarray:
+        self.ensure_jit_train()
         self.params, self.extra_vars, self.opt_state, loss = self._jit_train(
             self.params, self.extra_vars, self.opt_state,
             jnp.asarray(self.step), batch.x, batch.y, batch.w)

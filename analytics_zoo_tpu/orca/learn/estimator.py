@@ -260,29 +260,28 @@ class TPUEstimator:
         eng = self.engine
         # the probe's throwaway epoch() must not advance the iterator's
         # shuffle-seed counter, or auto runs would see different data orders
-        # than pinned runs
+        # than pinned runs — restore it on EVERY exit path
         epoch_counter = getattr(it, "_epoch", None)
         gen = it.epoch(shuffle=False, prefetch=False)
-        b0 = next(gen)
-        if eng._jit_train is None:
-            eng._jit_train = jax.jit(eng._train_step, donate_argnums=(0, 2))
-        compute_s = learn_utils.estimate_step_compute_s(
-            eng._jit_train,
-            (eng.params, eng.extra_vars, eng.opt_state,
-             jnp.asarray(eng.step), b0.x, b0.y, b0.w),
-            list(self.mesh.devices.flat))
-        if compute_s is not None and compute_s >= 0.01:
-            return 1        # compute-dominated: nothing worth amortizing
-        m = max(2, min(6, it.steps_per_epoch - 1,
-                       int((64 << 20) // max(batch_bytes, 1)) or 2))
-        probe = [b0]
-        for _ in range(m):
-            b = next(gen, None)
-            if b is None:
-                break
-            probe.append(b)           # device_put happens here, untimed
-        snap = eng.snapshot()
+        snap = None
         try:
+            b0 = next(gen)
+            compute_s = learn_utils.estimate_step_compute_s(
+                eng.ensure_jit_train(),
+                (eng.params, eng.extra_vars, eng.opt_state,
+                 jnp.asarray(eng.step), b0.x, b0.y, b0.w),
+                list(self.mesh.devices.flat))
+            if compute_s is not None and compute_s >= 0.01:
+                return 1    # compute-dominated: nothing worth amortizing
+            m = max(2, min(6, it.steps_per_epoch - 1,
+                           int((64 << 20) // max(batch_bytes, 1)) or 2))
+            probe = [b0]
+            for _ in range(m):
+                b = next(gen, None)
+                if b is None:
+                    break
+                probe.append(b)       # device_put happens here, untimed
+            snap = eng.snapshot()
             jax.block_until_ready(eng.train_batch(b0))   # compile + warm
             dt = float("inf")
             for _ in range(2):      # min-of-2 washes out contention spikes
@@ -292,7 +291,8 @@ class TPUEstimator:
                 jax.block_until_ready(loss)
                 dt = min(dt, (time.perf_counter() - t0) / m)
         finally:
-            eng.restore_snapshot(snap)
+            if snap is not None:
+                eng.restore_snapshot(snap)
             gen.close()
             if epoch_counter is not None:
                 it._epoch = epoch_counter

@@ -38,15 +38,38 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
 
 class InferenceModel:
     """(reference python wrapper: pyzoo/zoo/pipeline/inference/
-    inference_model.py:24 — load/load_tf/load_openvino + predict)"""
+    inference_model.py:24 — load/load_tf/load_openvino + predict)
+
+    Multi-chip: the model owns a 1-axis ``dp`` device mesh (default: every
+    local device). Params are replicated over it and the batch dim of every
+    request is sharded across it, so one predict() uses ALL local chips —
+    the TPU-native equivalent of the reference scaling serving with a
+    model-replica queue (InferenceModel.scala:580-626) and Flink
+    ``setParallelism(modelParallelism)`` (serving/ClusterServing.scala:60),
+    per SURVEY §2.3 ("per-core compiled executables; batch dim sharding").
+    Shape buckets are rounded up to a multiple of the device count so the
+    sharded leading dim always divides evenly.
+    """
 
     DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
     def __init__(self, supported_concurrent_num: int = 1,
-                 batch_buckets: Sequence[int] = DEFAULT_BUCKETS):
+                 batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 mesh=None):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         # concurrency arg kept for API parity; XLA executables are reentrant
         self.concurrency = supported_concurrent_num
-        self.buckets = tuple(sorted(batch_buckets))
+        if mesh is None:
+            mesh = Mesh(np.array(jax.local_devices()), ("dp",))
+        self.mesh = mesh
+        self._ndev = int(np.prod(list(mesh.shape.values())))
+        self._axes = tuple(mesh.axis_names)
+        self._repl = NamedSharding(mesh, P())
+        self._data_spec = P(self._axes)     # batch dim over every mesh axis
+        # buckets rounded so the sharded batch dim always divides the mesh
+        self.buckets = tuple(sorted(
+            {math.ceil(b / self._ndev) * self._ndev for b in batch_buckets}))
         self._apply_fn: Optional[Callable] = None
         self._variables = None
         self._cache: Dict[Tuple, Callable] = {}
@@ -56,6 +79,19 @@ class InferenceModel:
         # NMS/lookup ops (TFNet's main use case) are not — those apply_fns
         # must run eagerly so TF executes its own kernels host-side.
         self._eager = False
+
+    @property
+    def device_count(self) -> int:
+        """Chips one predict() actually computes on (1 for eager/call_tf
+        models, which run TF kernels host-side)."""
+        return 1 if self._eager else self._ndev
+
+    def _shard_batch(self, arr):
+        """Place one padded input on the mesh, batch dim sharded."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = self._data_spec if arr.ndim else P()
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
     # --- loaders ------------------------------------------------------------
     def load_jax(self, module, variables) -> "InferenceModel":
@@ -68,7 +104,7 @@ class InferenceModel:
             return out
 
         self._apply_fn = apply_fn
-        self._variables = jax.device_put(variables)
+        self._variables = jax.device_put(variables, self._repl)
         self._eager = False
         self._cache.clear()
         return self
@@ -129,7 +165,7 @@ class InferenceModel:
             return orig_apply(jax.tree_util.tree_unflatten(treedef, deq), *x)
 
         self._apply_fn = apply_fn
-        self._variables = jax.device_put(q_vars)
+        self._variables = jax.device_put(q_vars, self._repl)
         self._cache.clear()
         logger.info("quantized %d weight tensors to int8", n_quantized)
         return self
@@ -327,28 +363,38 @@ class InferenceModel:
             module, loader = self._pending_torch
             variables = module.init(jax.random.PRNGKey(0),
                                     *[a[:1] for a in xs])
-            self._variables = jax.device_put(loader(variables))
+            self._variables = jax.device_put(loader(variables), self._repl)
         n = len(xs[0])
         if self._eager:
             # no compilation to amortize — padding would just run the TF
             # graph on phantom rows
             out = self._apply_fn(self._variables, *xs)
         else:
-            b = _bucket(n, self.buckets)
-            padded = [np.concatenate(
-                [a, np.zeros((b - n,) + a.shape[1:], a.dtype)]) if b > n
-                else a for a in xs]
-            key = (b,) + tuple((a.shape[1:], str(a.dtype)) for a in padded)
-            with self._lock:
-                fn = self._cache.get(key)
-                if fn is None:
-                    fn = jax.jit(self._apply_fn)
-                    self._cache[key] = fn
-            out = fn(self._variables, *padded)
+            out = self._predict_device(xs, n)
         out = jax.device_get(out)
         if isinstance(out, (list, tuple)):
             return type(out)(np.asarray(o)[:n] for o in out)
         return np.asarray(out)[:n]
+
+    def _predict_device(self, xs, n: int):
+        """Run the bucketed executable; returns the ON-DEVICE output, batch
+        dim sharded over the mesh (all local chips compute). ``predict``
+        fetches to host; callers that keep chaining on device can use this
+        directly."""
+        import jax
+
+        b = _bucket(n, self.buckets)
+        padded = [np.concatenate(
+            [a, np.zeros((b - n,) + a.shape[1:], a.dtype)]) if b > n
+            else np.asarray(a) for a in xs]
+        dev = [self._shard_batch(a) for a in padded]
+        key = (b,) + tuple((a.shape[1:], str(a.dtype)) for a in padded)
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = jax.jit(self._apply_fn)
+                self._cache[key] = fn
+        return fn(self._variables, *dev)
 
     def distributed_predict(self, shards, batch_size: int = 64):
         """Predict over XShards (reference: PythonOrca.

@@ -151,7 +151,12 @@ class ClusterServing:
     def metrics(self) -> Dict:
         """(reference observability: Flink numRecordsOutPerSecond +
         Timer stats)"""
-        return {"records_out": self.records_out, "stages": self.timer.summary()}
+        return {"records_out": self.records_out,
+                # batch-dim sharding spreads every batch over these chips
+                # (reference scales with model replicas / Flink parallelism);
+                # 1 for eager/call_tf models, which compute host-side
+                "devices": getattr(self.model, "device_count", 1),
+                "stages": self.timer.summary()}
 
     def reset_metrics(self):
         """Zero the stage timers and record counter — call after warmup so
