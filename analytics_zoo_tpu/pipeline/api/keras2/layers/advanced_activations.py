@@ -1,0 +1,34 @@
+"""keras2 advanced activations — tf.keras argument names over the keras-v1
+flax modules (reference: pyzoo/zoo/pipeline/api/keras2/layers/
+advanced_activations.py is a license-only stub; these factories expose the
+tf.keras surface over the same flax activation modules)."""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+from .core import _shape
+
+__all__ = ["LeakyReLU", "ELU", "PReLU", "ThresholdedReLU"]
+
+
+def LeakyReLU(alpha=0.3, input_shape=None, **kwargs):
+    return K1.LeakyReLU(alpha=float(alpha),
+                        input_shape=_shape(None, input_shape), **kwargs)
+
+
+def ELU(alpha=1.0, input_shape=None, **kwargs):
+    return K1.ELU(alpha=float(alpha),
+                  input_shape=_shape(None, input_shape), **kwargs)
+
+
+def PReLU(shared_axes=None, input_shape=None, **kwargs):
+    """tf.keras PReLU learns one slope per channel; ``shared_axes`` beyond
+    the v1 per-plane sharing is not supported."""
+    del shared_axes
+    return K1.PReLU(input_shape=_shape(None, input_shape), **kwargs)
+
+
+def ThresholdedReLU(theta=1.0, input_shape=None, **kwargs):
+    return K1.ThresholdedReLU(theta=float(theta),
+                              input_shape=_shape(None, input_shape),
+                              **kwargs)

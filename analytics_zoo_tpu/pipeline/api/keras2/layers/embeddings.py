@@ -1,0 +1,37 @@
+"""keras2 embedding layer — tf.keras argument names over the keras-v1 flax
+Embedding (reference: pyzoo/zoo/pipeline/api/keras2/layers/embeddings.py is
+a license-only stub; this factory exposes the tf.keras surface —
+``embeddings_initializer`` instead of the v1 ``init`` — over the same
+MXU-routed embedding module)."""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+from .core import _shape
+
+__all__ = ["Embedding"]
+
+
+def Embedding(input_dim, output_dim, embeddings_initializer="uniform",
+              weights=None, trainable=True, input_length=None,
+              input_shape=None, **kwargs):
+    """tf.keras Embedding(input_dim, output_dim, embeddings_initializer).
+
+    ``input_length`` maps to the v1 ``input_shape=(length,)`` convention;
+    tf.keras ids are zero-based (v1 BigDL's were one-based), which the
+    flax module handles via ``zero_based_id``. keras-2 callers pass
+    ``weights=[matrix]`` (a list); the v1 module takes the bare matrix."""
+    if input_length is not None and input_shape is None:
+        input_shape = (int(input_length),)
+    if isinstance(weights, (list, tuple)):
+        if len(weights) != 1:
+            raise ValueError(
+                f"weights must be [embedding_matrix], got {len(weights)} "
+                "arrays")
+        weights = weights[0]
+    return K1.Embedding(input_dim=int(input_dim),
+                        output_dim=int(output_dim),
+                        init_method=embeddings_initializer,
+                        weights=weights, trainable=trainable,
+                        zero_based_id=True,
+                        input_shape=_shape(None, input_shape), **kwargs)

@@ -1,0 +1,22 @@
+"""keras2 noise layers — tf.keras argument names over the keras-v1 flax
+modules (reference: pyzoo/zoo/pipeline/api/keras2/layers/noise.py is a
+license-only stub; these factories expose the tf.keras surface — ``stddev``
+instead of the v1 ``sigma``, ``rate`` instead of ``p``)."""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+from .core import _shape
+
+__all__ = ["GaussianNoise", "GaussianDropout"]
+
+
+def GaussianNoise(stddev, input_shape=None, **kwargs):
+    return K1.GaussianNoise(sigma=float(stddev),
+                            input_shape=_shape(None, input_shape), **kwargs)
+
+
+def GaussianDropout(rate, input_shape=None, **kwargs):
+    return K1.GaussianDropout(p=float(rate),
+                              input_shape=_shape(None, input_shape),
+                              **kwargs)

@@ -1,0 +1,35 @@
+"""keras2 normalization — tf.keras argument names over the keras-v1 flax
+BatchNormalization (reference: pyzoo/zoo/pipeline/api/keras2/layers/
+normalization.py is a license-only stub; this factory exposes the tf.keras
+surface — ``axis``, ``momentum``, ``epsilon`` — over the same flax
+batch-stats module)."""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+from .core import _shape
+
+__all__ = ["BatchNormalization"]
+
+
+def BatchNormalization(axis=-1, momentum=0.99, epsilon=1e-3,
+                       beta_initializer="zeros", gamma_initializer="ones",
+                       input_shape=None, **kwargs):
+    """tf.keras BatchNormalization(axis=-1, momentum, epsilon).
+
+    ``axis`` passes straight through to the flax module (it normalizes over
+    every other dim). The v1 module initializes beta/gamma to zeros/ones
+    only, so any other initializer is rejected rather than silently
+    ignored."""
+    if beta_initializer not in ("zeros", "zero"):
+        raise ValueError(
+            f"beta_initializer={beta_initializer!r} unsupported: the flax "
+            "BatchNormalization initializes beta to zeros")
+    if gamma_initializer not in ("ones", "one"):
+        raise ValueError(
+            f"gamma_initializer={gamma_initializer!r} unsupported: the "
+            "flax BatchNormalization initializes gamma to ones")
+    return K1.BatchNormalization(
+        epsilon=float(epsilon), momentum=float(momentum),
+        axis=int(axis),
+        input_shape=_shape(None, input_shape), **kwargs)

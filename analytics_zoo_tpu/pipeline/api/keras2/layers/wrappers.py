@@ -1,0 +1,23 @@
+"""keras2 wrappers — tf.keras surface over the keras-v1 flax wrapper
+modules (reference: pyzoo/zoo/pipeline/api/keras2/layers/wrappers.py is a
+license-only stub; TimeDistributed and Bidirectional pass through to the
+same flax implementations, which already take the wrapped layer as the
+first argument like tf.keras)."""
+
+from __future__ import annotations
+
+from ...keras import layers as K1
+from .core import _shape
+
+__all__ = ["TimeDistributed", "Bidirectional"]
+
+
+def TimeDistributed(layer, input_shape=None, **kwargs):
+    return K1.TimeDistributed(layer=layer,
+                              input_shape=_shape(None, input_shape),
+                              **kwargs)
+
+
+def Bidirectional(layer, merge_mode="concat", input_shape=None, **kwargs):
+    return K1.Bidirectional(layer=layer, merge_mode=merge_mode,
+                            input_shape=_shape(None, input_shape), **kwargs)

@@ -87,3 +87,82 @@ def test_functional_merge_graph(orca_context):
     pred = model.predict([a, b])
     np.testing.assert_allclose(np.asarray(pred), np.maximum(a, b),
                                rtol=1e-6)
+
+
+def test_new_module_factories_build_v1_modules():
+    """One layer per round-5 module: recurrent, embeddings, normalization,
+    advanced_activations, noise, wrappers, convolutional_recurrent (the
+    reference files are license-only stubs; ours carry real factories)."""
+    ls = K2.LSTM(7, return_sequences=True)
+    assert isinstance(ls, K1.LSTM)
+    assert ls.output_dim == 7 and ls.return_sequences
+    assert ls.inner_activation == "hard_sigmoid"
+
+    g = K2.GRU(5, recurrent_activation="sigmoid")
+    assert isinstance(g, K1.GRU) and g.inner_activation == "sigmoid"
+
+    sr = K2.SimpleRNN(3)
+    assert isinstance(sr, K1.SimpleRNN) and sr.output_dim == 3
+
+    em = K2.Embedding(100, 16, input_length=12)
+    assert isinstance(em, K1.Embedding)
+    assert (em.input_dim, em.output_dim) == (100, 16)
+    assert em.zero_based_id and em.input_shape == (12,)
+    # keras-2 callers pass weights=[matrix]; the bare matrix reaches v1
+    mat = np.zeros((100, 16), np.float32)
+    assert K2.Embedding(100, 16, weights=[mat]).weights.shape == (100, 16)
+
+    bn = K2.BatchNormalization(momentum=0.9, epsilon=1e-5)
+    assert isinstance(bn, K1.BatchNormalization)
+    assert bn.momentum == 0.9 and bn.epsilon == 1e-5
+    assert bn.axis == -1                    # tf.keras channels-last default
+    assert K2.BatchNormalization(axis=1).axis == 1
+    with pytest.raises(ValueError, match="beta_initializer"):
+        K2.BatchNormalization(beta_initializer="glorot_uniform")
+
+    lr = K2.LeakyReLU(alpha=0.1)
+    assert isinstance(lr, K1.LeakyReLU) and lr.alpha == 0.1
+    assert isinstance(K2.ELU(), K1.ELU)
+    assert isinstance(K2.PReLU(), K1.PReLU)
+    assert isinstance(K2.ThresholdedReLU(theta=0.5), K1.ThresholdedReLU)
+
+    gn = K2.GaussianNoise(stddev=0.2)
+    assert isinstance(gn, K1.GaussianNoise) and gn.sigma == 0.2
+    gd = K2.GaussianDropout(rate=0.3)
+    assert isinstance(gd, K1.GaussianDropout) and gd.p == 0.3
+
+    td = K2.TimeDistributed(K2.Dense(4))
+    assert isinstance(td, K1.TimeDistributed)
+    bi = K2.Bidirectional(K2.LSTM(4), merge_mode="sum")
+    assert isinstance(bi, K1.Bidirectional) and bi.merge_mode == "sum"
+
+    cl = K2.ConvLSTM2D(8, 3, padding="same", return_sequences=True)
+    assert isinstance(cl, K1.ConvLSTM2D)
+    assert cl.nb_filter == 8 and cl.nb_kernel == 3
+    assert cl.dim_ordering == "tf" and cl.return_sequences
+    with pytest.raises(ValueError, match="square"):
+        K2.ConvLSTM2D(8, (3, 5))
+    # the v1 cell computes SAME/stride-1 only: reject, don't silently drop
+    with pytest.raises(ValueError, match="padding"):
+        K2.ConvLSTM2D(8, 3, padding="valid")
+    with pytest.raises(ValueError, match="strides"):
+        K2.ConvLSTM2D(8, 3, strides=(2, 2))
+
+
+def test_keras2_recurrent_stack_trains(orca_context):
+    """A tf.keras-style Embedding -> LSTM -> Dense stack must train through
+    the shared Sequential engine."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 50, (64, 10)).astype(np.int32)
+    y = (x.sum(-1) % 2).astype(np.int64)
+    m = Sequential([
+        K2.Embedding(50, 8, input_length=10),
+        K2.LSTM(16),
+        K2.Dense(2, activation="softmax"),
+    ])
+    m.compile(loss="sparse_categorical_crossentropy", optimizer="adam")
+    stats = m.fit(x, y, batch_size=32, nb_epoch=2, verbose=False)
+    assert np.isfinite(stats[-1]["train_loss"])
+    assert np.asarray(m.predict(x[:4])).shape == (4, 2)
