@@ -22,9 +22,13 @@ def ELU(alpha=1.0, input_shape=None, **kwargs):
 
 
 def PReLU(shared_axes=None, input_shape=None, **kwargs):
-    """tf.keras PReLU learns one slope per channel; ``shared_axes`` beyond
-    the v1 per-plane sharing is not supported."""
-    del shared_axes
+    """tf.keras PReLU. The v1 module learns a single shared slope
+    (n_output_plane=0); ``shared_axes`` would change the parameter
+    structure, so it is rejected rather than silently dropped."""
+    if shared_axes is not None:
+        raise ValueError(
+            "PReLU(shared_axes=...) is not supported: the flax PReLU "
+            "learns one shared slope (v1 n_output_plane=0)")
     return K1.PReLU(input_shape=_shape(None, input_shape), **kwargs)
 
 

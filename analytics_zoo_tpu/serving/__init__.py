@@ -1,8 +1,9 @@
 from .client import InputQueue, OutputQueue
+from .codecs import SparseTensor
 from .engine import ClusterServing, Timer
 from .queue_api import FileBroker, InMemoryBroker, RedisBroker, make_broker
 from .redis_protocol import MiniRedisServer, RedisClient
 
 __all__ = ["InputQueue", "OutputQueue", "ClusterServing", "Timer",
            "InMemoryBroker", "FileBroker", "RedisBroker", "MiniRedisServer",
-           "RedisClient", "make_broker"]
+           "RedisClient", "make_broker", "SparseTensor"]

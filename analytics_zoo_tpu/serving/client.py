@@ -61,12 +61,16 @@ class InputQueue(API):
                 if self._last_pending >= self.max_pending:
                     _time.sleep(self._poll_s)
             self._sent_since += 1
+        from .codecs import SparseTensor
+
+        def norm(v):
+            return v if isinstance(v, SparseTensor) else np.asarray(v)
+
         if len(data) == 1:
-            payload = encode_payload(np.asarray(next(iter(data.values()))),
+            payload = encode_payload(norm(next(iter(data.values()))),
                                      meta={"uri": uri})
         else:
-            payload = encode_payload({k: np.asarray(v)
-                                      for k, v in data.items()},
+            payload = encode_payload({k: norm(v) for k, v in data.items()},
                                      meta={"uri": uri})
         self.broker.enqueue(uri, payload)
         return uri

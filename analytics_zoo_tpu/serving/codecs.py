@@ -1,15 +1,73 @@
 """Wire codecs for serving payloads — ndarray <-> base64(arrow), matching the
 reference client's encoding (pyzoo/zoo/serving/client.py:267-282 b64 + arrow
-streaming format; JVM twin serving/arrow/ArrowSerializer.scala:170)."""
+streaming format; JVM twin serving/arrow/ArrowSerializer.scala:170). Sparse
+tensors ride the same wire as {shape, data, indices} triples, the reference
+ingress schema (serving/http/domains.scala:100 ``SparseTensor[T](shape,
+data, indices)``) — recommendation traffic routinely sends sparse features.
+"""
 
 from __future__ import annotations
 
 import base64
 import io
 import json
+from dataclasses import dataclass
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
+
+
+@dataclass
+class SparseTensor:
+    """COO sparse tensor (reference: http/domains.scala:100).
+
+    ``indices`` is (nnz, ndim) int; ``data`` is (nnz,) values. The TPU
+    compute path is dense (XLA static shapes), so serving densifies at
+    batch-assembly time via ``to_dense`` — for the reference's
+    recommendation models these are small per-record feature vectors, and
+    the dense batch then rides the normal bucketed executable."""
+    shape: Tuple[int, ...]
+    data: np.ndarray
+    indices: np.ndarray
+
+    def __post_init__(self):
+        self.shape = tuple(int(s) for s in self.shape)
+        self.data = np.asarray(self.data)
+        self.indices = np.asarray(self.indices, dtype=np.int64)
+        if self.indices.size == 0:     # all-zero tensor: [] at any rank
+            self.indices = self.indices.reshape(0, len(self.shape))
+        if self.indices.ndim == 1:     # 1-D tensor: allow flat index lists
+            self.indices = self.indices[:, None]
+        if self.indices.shape != (len(self.data), len(self.shape)):
+            raise ValueError(
+                f"indices shape {self.indices.shape} does not match "
+                f"{len(self.data)} values over a rank-{len(self.shape)} "
+                "tensor")
+        # reject out-of-range at ingress: negative indices would silently
+        # wrap in to_dense, and overflow would explode at batch time —
+        # inside a co-batched group, failing OTHER clients' requests
+        if len(self.data):
+            upper = np.asarray(self.shape, dtype=np.int64)
+            if (self.indices < 0).any() or (self.indices >= upper).any():
+                raise ValueError(
+                    f"indices out of range for shape {self.shape}")
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.shape, dtype=self.data.dtype)
+        if len(self.data):
+            out[tuple(self.indices.T)] = self.data
+        return out
+
+
+def densify(data):
+    """Replace any SparseTensor in a decoded payload with its dense form."""
+    if isinstance(data, SparseTensor):
+        return data.to_dense()
+    if isinstance(data, list):
+        return [densify(d) for d in data]
+    if isinstance(data, dict):
+        return {k: densify(v) for k, v in data.items()}
+    return data
 
 
 def encode_ndarray(arr: np.ndarray) -> str:
@@ -28,17 +86,35 @@ def decode_ndarray(s: str) -> np.ndarray:
     return tensor.to_numpy()
 
 
+def _encode_one(data) -> Dict:
+    if isinstance(data, SparseTensor):
+        return {"kind": "sparse", "shape": list(data.shape),
+                "data": encode_ndarray(data.data),
+                "indices": encode_ndarray(data.indices)}
+    return {"kind": "tensor", "data": encode_ndarray(np.asarray(data))}
+
+
+def _decode_one(body):
+    if isinstance(body, str):              # bare tensor (legacy form)
+        return decode_ndarray(body)
+    if body["kind"] == "sparse":
+        return SparseTensor(shape=tuple(body["shape"]),
+                            data=decode_ndarray(body["data"]),
+                            indices=decode_ndarray(body["indices"]))
+    return decode_ndarray(body["data"])
+
+
 def encode_payload(data: Any, meta: Dict | None = None) -> bytes:
-    """data: ndarray | list/tuple of ndarray | dict[str, ndarray]."""
+    """data: ndarray | SparseTensor | list/tuple | dict[str, ...] of them."""
     if isinstance(data, np.ndarray):
         body = {"kind": "tensor", "data": encode_ndarray(data)}
+    elif isinstance(data, SparseTensor):
+        body = _encode_one(data)
     elif isinstance(data, (list, tuple)):
-        body = {"kind": "tensors",
-                "data": [encode_ndarray(np.asarray(a)) for a in data]}
+        body = {"kind": "tensors", "data": [_encode_one(a) for a in data]}
     elif isinstance(data, dict):
         body = {"kind": "named",
-                "data": {k: encode_ndarray(np.asarray(v))
-                         for k, v in data.items()}}
+                "data": {k: _encode_one(v) for k, v in data.items()}}
     else:
         raise ValueError(f"cannot encode {type(data)}")
     if meta:
@@ -49,10 +125,10 @@ def encode_payload(data: Any, meta: Dict | None = None) -> bytes:
 def decode_payload(raw: bytes) -> Tuple[Any, Dict]:
     body = json.loads(raw.decode("utf-8"))
     kind = body["kind"]
-    if kind == "tensor":
-        data = decode_ndarray(body["data"])
+    if kind in ("tensor", "sparse"):
+        data = _decode_one(body)
     elif kind == "tensors":
-        data = [decode_ndarray(s) for s in body["data"]]
+        data = [_decode_one(s) for s in body["data"]]
     else:
-        data = {k: decode_ndarray(v) for k, v in body["data"].items()}
+        data = {k: _decode_one(v) for k, v in body["data"].items()}
     return data, body.get("meta", {})

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..pipeline.inference.inference_model import InferenceModel
-from .codecs import decode_payload, encode_payload
+from .codecs import decode_payload, densify, encode_payload
 from .queue_api import Broker, make_broker
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -103,12 +103,21 @@ class ClusterServing:
     def _process(self, batch):
         with self.timer.time("decode"):
             decoded = [decode_payload(p) for _, p in batch]
-            arrays = [d for d, _ in decoded]
+            # sparse ingress (reference: http/domains.scala:100) densifies
+            # at batch assembly — the TPU executable wants static dense
+            arrays = [densify(d) for d, _ in decoded]
         with self.timer.time("batch"):
             first = arrays[0]
             if isinstance(first, list):
                 stacked = [np.stack([a[i] for a in arrays])
                            for i in range(len(first))]
+            elif isinstance(first, dict):
+                # named multi-tensor records: stack per key, feed the model
+                # positionally in the record's key order (the reference's
+                # LinkedHashMap instances preserve order the same way,
+                # http/domains.scala:102)
+                stacked = [np.stack([a[k] for a in arrays])
+                           for k in first.keys()]
             else:
                 stacked = np.stack(arrays)
         with self.timer.time("inference"):

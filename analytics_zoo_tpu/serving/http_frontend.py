@@ -4,29 +4,64 @@ PUT /predict with JSON instances; domain schema http/domains.scala).
 
 POST/PUT /predict body: {"instances": [{"t": [[...]]}, ...]} — each instance's
 tensors are enqueued onto the serving broker; the handler awaits results and
-returns {"predictions": [...]}.
+returns {"predictions": [...]}. A tensor value may also be a sparse triple
+{"shape": [...], "data": [...], "indices": [[...]]} (reference:
+http/domains.scala:100 SparseTensor).
+
+Transport security (reference FrontEndApp.scala:230-235 httpsEnabled +
+:145-157 model-secure): ``run_frontend(ssl_certfile=, ssl_keyfile=)`` serves
+HTTPS, ``auth_token`` requires ``Authorization: Bearer <token>`` on every
+route but GET /, and POST /model-secure stores the secret/salt an encrypted
+model artifact needs (utils/crypto.py sealed checkpoints).
 """
 
 from __future__ import annotations
 
 import asyncio
+import hmac
 import uuid
 from typing import Optional
 
 import numpy as np
 
-from .codecs import decode_payload, encode_payload
+from .codecs import SparseTensor, decode_payload, encode_payload
 from .queue_api import Broker, make_broker
 
 
+def _parse_tensor_value(v):
+    """A JSON instance value: nested list (dense) or {shape,data,indices}
+    (sparse, reference http/domains.scala:100)."""
+    if isinstance(v, dict) and {"shape", "data", "indices"} <= set(v):
+        return SparseTensor(shape=tuple(v["shape"]),
+                            data=np.asarray(v["data"], np.float32),
+                            indices=np.asarray(v["indices"]))
+    return np.asarray(v, dtype=np.float32)
+
+
 def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
-               serving=None):
+               serving=None, auth_token: Optional[str] = None):
     """``serving``: optional ClusterServing engine to expose under
     GET /metrics (the reference surfaces Flink numRecordsOutPerSecond +
-    stage timers the same way, ClusterServingGuide:525)."""
+    stage timers the same way, ClusterServingGuide:525). ``auth_token``:
+    when set, every route but GET / requires
+    ``Authorization: Bearer <auth_token>``."""
     from aiohttp import web
 
     broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
+
+    @web.middleware
+    async def auth_middleware(request, handler):
+        if auth_token and request.path != "/":
+            header = request.headers.get("Authorization", "")
+            # compare as bytes: str compare_digest raises on non-ASCII
+            # header values, which must 401, not 500
+            ok = header.startswith("Bearer ") and hmac.compare_digest(
+                header[len("Bearer "):].encode("utf-8", "surrogateescape"),
+                auth_token.encode("utf-8"))
+            if not ok:
+                return web.json_response({"error": "unauthorized"},
+                                         status=401)
+        return await handler(request)
 
     async def index(request):
         return web.Response(text="welcome to analytics zoo tpu serving "
@@ -52,12 +87,19 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         uris = []
         for inst in instances:
             uri = uuid.uuid4().hex
-            if isinstance(inst, dict):
-                named = {k: np.asarray(v, dtype=np.float32)
-                         for k, v in inst.items()}
-                data = next(iter(named.values())) if len(named) == 1 else named
-            else:
-                data = np.asarray(inst, dtype=np.float32)
+            try:
+                if isinstance(inst, dict):
+                    named = {k: _parse_tensor_value(v)
+                             for k, v in inst.items()}
+                    data = (next(iter(named.values()))
+                            if len(named) == 1 else named)
+                else:
+                    data = np.asarray(inst, dtype=np.float32)
+            except (ValueError, TypeError) as e:
+                # malformed instance (bad sparse triple, ragged list):
+                # client error, not a 500
+                return web.json_response(
+                    {"error": f"bad instance: {e}"}, status=400)
             broker.enqueue(uri, encode_payload(data, meta={"uri": uri}))
             uris.append(uri)
 
@@ -76,18 +118,51 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
             *[loop.run_in_executor(None, fetch, u) for u in uris])
         return web.json_response({"predictions": results})
 
-    app = web.Application()
+    async def model_secure(request):
+        """Store the secret/salt an encrypted model artifact is sealed with
+        (reference FrontEndApp.scala:145-157 posts them to redis; here they
+        land in app state for the embedded worker / operator to read).
+        Body: ``secret=xxx&salt=yyy`` like the reference."""
+        content = await request.text()
+        try:
+            parts = dict(kv.split("=", 1) for kv in content.split("&"))
+            app["model_secret"] = parts["secret"]
+            app["model_salt"] = parts["salt"]
+        except (ValueError, KeyError):
+            return web.json_response(
+                {"error": "please post a content like secret=xxx&salt=yyy"},
+                status=400)
+        return web.Response(text="model secured secret and salt succeed "
+                                 "to put in app state")
+
+    app = web.Application(middlewares=[auth_middleware])
     app.router.add_get("/", index)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/predict", predict)
     app.router.add_put("/predict", predict)
+    app.router.add_post("/model-secure", model_secure)
     return app
 
 
+def make_ssl_context(certfile: str, keyfile: str):
+    """Server TLS context (reference: FrontEndApp defineServerContext over a
+    PKCS12 keystore, FrontEndApp.scala:230-235; here a PEM cert/key pair)."""
+    import ssl
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    return ctx
+
+
 def run_frontend(queue="memory://serving_stream", host: str = "0.0.0.0",
-                 port: int = 10020):
+                 port: int = 10020, serving=None,
+                 auth_token: Optional[str] = None,
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None):
     from aiohttp import web
-    web.run_app(create_app(queue), host=host, port=port)
+    ssl_ctx = (make_ssl_context(ssl_certfile, ssl_keyfile)
+               if ssl_certfile and ssl_keyfile else None)
+    web.run_app(create_app(queue, serving=serving, auth_token=auth_token),
+                host=host, port=port, ssl_context=ssl_ctx)
 
 
 def main(argv=None):
@@ -118,7 +193,18 @@ def main(argv=None):
                         "frozen .pb")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    p.add_argument("--auth-token", default=None,
+                   help="require 'Authorization: Bearer <token>' on every "
+                        "route but GET / (reference model-secure/secured "
+                        "serving, FrontEndApp.scala:145)")
+    p.add_argument("--https-cert", default=None,
+                   help="PEM certificate: serve HTTPS (reference "
+                        "httpsEnabled, FrontEndApp.scala:230)")
+    p.add_argument("--https-key", default=None,
+                   help="PEM private key for --https-cert")
     args = p.parse_args(argv)
+    if bool(args.https_cert) != bool(args.https_key):
+        p.error("--https-cert and --https-key must be given together")
 
     serving = None
     if args.model:
@@ -143,7 +229,10 @@ def main(argv=None):
             model, queue=args.queue, batch_size=args.batch_size,
             batch_timeout_ms=args.batch_timeout_ms).start()
     try:
-        run_frontend(queue=args.queue, host=args.host, port=args.port)
+        run_frontend(queue=args.queue, host=args.host, port=args.port,
+                     serving=serving, auth_token=args.auth_token,
+                     ssl_certfile=args.https_cert,
+                     ssl_keyfile=args.https_key)
     finally:
         if serving is not None:
             serving.stop()

@@ -445,3 +445,183 @@ def test_encrypted_checkpoint_roundtrip(orca_context, tmp_path):
     # primitive sanity: exact byte roundtrip incl. odd lengths
     for payload in (b"", b"x", bytes(range(256)) * 7):
         assert decrypt_bytes(encrypt_bytes(payload, "k"), "k") == payload
+
+
+def test_sparse_tensor_codec_roundtrip():
+    """Sparse ingress parity (reference http/domains.scala:100
+    SparseTensor(shape, data, indices)): wire roundtrip + densify."""
+    from analytics_zoo_tpu.serving.codecs import SparseTensor, densify
+
+    st = SparseTensor(shape=(3, 4),
+                      data=np.array([1.5, 2.5], np.float32),
+                      indices=np.array([[0, 1], [2, 3]]))
+    raw = encode_payload(st, meta={"uri": "s"})
+    back, meta = decode_payload(raw)
+    assert isinstance(back, SparseTensor) and meta["uri"] == "s"
+    dense = densify(back)
+    expect = np.zeros((3, 4), np.float32)
+    expect[0, 1], expect[2, 3] = 1.5, 2.5
+    np.testing.assert_array_equal(dense, expect)
+    # named payload with a mix of dense and sparse
+    mixed, _ = decode_payload(encode_payload({"a": np.ones(2), "b": st}))
+    assert isinstance(mixed["b"], SparseTensor)
+    np.testing.assert_array_equal(densify(mixed)["b"], expect)
+    # shape validation
+    with pytest.raises(ValueError, match="indices"):
+        SparseTensor(shape=(3,), data=np.ones(2), indices=np.zeros((2, 2)))
+
+
+def test_sparse_end_to_end_serving(orca_context):
+    """A sparse record must flow queue -> densify -> bucketed executable ->
+    result (recommendation traffic routinely sends sparse features)."""
+    from analytics_zoo_tpu.serving import SparseTensor
+
+    model = _simple_model()                  # Dense(3) over 4 features
+    serving = ClusterServing(model, queue="memory://sp1", batch_size=4,
+                             batch_timeout_ms=10).start()
+    try:
+        inq = InputQueue("memory://sp1")
+        outq = OutputQueue("memory://sp1")
+        sp = SparseTensor(shape=(4,), data=np.array([2.0], np.float32),
+                          indices=np.array([[1]]))
+        uri = inq.enqueue("sparse-1", t=sp)
+        out = outq.query(uri, timeout_s=15)
+        assert isinstance(out, np.ndarray) and out.shape == (3,)
+        # numerics: same as the dense equivalent
+        ref = model.predict(sp.to_dense()[None])[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    finally:
+        serving.stop()
+
+
+def test_frontend_auth_and_sparse(orca_context):
+    """Bearer-token auth (401 without/with-wrong token, 200 with) and a
+    sparse instance value through POST /predict."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from analytics_zoo_tpu.serving import InMemoryBroker
+    from analytics_zoo_tpu.serving.http_frontend import create_app
+
+    model = _simple_model()
+    broker = InMemoryBroker()
+    serving = ClusterServing(model, queue=broker, batch_size=4,
+                             batch_timeout_ms=10).start()
+    try:
+        async def run():
+            app = create_app(queue=broker, serving=serving,
+                             auth_token="sesame")
+            async with TestClient(TestServer(app)) as client:
+                r0 = await client.get("/")            # index stays open
+                r1 = await client.get("/metrics")     # no token -> 401
+                r2 = await client.get("/metrics", headers={
+                    "Authorization": "Bearer wrong"})
+                hdr = {"Authorization": "Bearer sesame"}
+                r3 = await client.get("/metrics", headers=hdr)
+                sparse_inst = {"t": {"shape": [4], "data": [2.0],
+                                     "indices": [[1]]}}
+                r4 = await client.post(
+                    "/predict", json={"instances": [sparse_inst]},
+                    headers=hdr)
+                preds = (await r4.json())["predictions"]
+                r5 = await client.post("/model-secure",
+                                       data="secret=abc&salt=xyz",
+                                       headers=hdr)
+                return (r0.status, r1.status, r2.status, r3.status,
+                        r4.status, preds, r5.status,
+                        app["model_secret"], app["model_salt"])
+
+        (s0, s1, s2, s3, s4, preds, s5, sec, salt) = \
+            asyncio.new_event_loop().run_until_complete(run())
+        assert (s0, s1, s2, s3, s4, s5) == (200, 401, 401, 200, 200, 200)
+        assert len(preds) == 1 and len(preds[0]) == 3
+        assert (sec, salt) == ("abc", "xyz")
+    finally:
+        serving.stop()
+
+
+def test_frontend_https_smoke(orca_context, tmp_path):
+    """HTTPS parity (reference FrontEndApp.scala:230-235): the frontend
+    serves over TLS with a PEM cert/key pair."""
+    import asyncio
+    import ssl
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-subj", "/CN=localhost", "-keyout", str(key), "-out", str(cert),
+         "-days", "1"], check=True, capture_output=True)
+
+    from analytics_zoo_tpu.serving import InMemoryBroker
+    from analytics_zoo_tpu.serving.http_frontend import (create_app,
+                                                         make_ssl_context)
+
+    broker = InMemoryBroker()
+
+    async def run():
+        from aiohttp import ClientSession, TCPConnector, web
+        app = create_app(queue=broker)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0,
+                           ssl_context=make_ssl_context(str(cert), str(key)))
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        client_ctx = ssl.create_default_context()
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl.CERT_NONE
+        async with ClientSession(
+                connector=TCPConnector(ssl=client_ctx)) as sess:
+            resp = await sess.get(f"https://127.0.0.1:{port}/")
+            text = await resp.text()
+        await runner.cleanup()
+        return resp.status, text
+
+    status, text = asyncio.new_event_loop().run_until_complete(run())
+    assert status == 200 and "welcome" in text
+
+
+def test_sparse_validation_and_named_batching(orca_context):
+    """Round-5 review fixes: out-of-range sparse indices rejected at
+    ingress; empty sparse tensors of any rank allowed; named multi-tensor
+    records batch per-key through the engine."""
+    from analytics_zoo_tpu.serving.codecs import SparseTensor
+
+    with pytest.raises(ValueError, match="out of range"):
+        SparseTensor(shape=(4,), data=np.ones(1), indices=np.array([[-1]]))
+    with pytest.raises(ValueError, match="out of range"):
+        SparseTensor(shape=(4,), data=np.ones(1), indices=np.array([[7]]))
+    empty = SparseTensor(shape=(3, 4), data=np.zeros(0, np.float32),
+                         indices=np.zeros(0))
+    np.testing.assert_array_equal(empty.to_dense(), np.zeros((3, 4)))
+
+    # named two-input record end-to-end (engine stacks per key)
+    import flax.linen as nn
+    import jax
+
+    class TwoIn(nn.Module):
+        @nn.compact
+        def __call__(self, a, b):
+            return nn.Dense(2)(a) + nn.Dense(2)(b)
+
+    m = TwoIn()
+    v = m.init(jax.random.PRNGKey(0), np.zeros((1, 3), np.float32),
+               np.zeros((1, 5), np.float32))
+    model = InferenceModel().load_jax(m, v)
+    serving = ClusterServing(model, queue="memory://nm1", batch_size=4,
+                             batch_timeout_ms=10).start()
+    try:
+        inq = InputQueue("memory://nm1")
+        outq = OutputQueue("memory://nm1")
+        uri = inq.enqueue("two-1", a=np.ones(3, np.float32),
+                          b=np.ones(5, np.float32))
+        out = outq.query(uri, timeout_s=15)
+        assert isinstance(out, np.ndarray) and out.shape == (2,)
+        ref = model.predict([np.ones((1, 3), np.float32),
+                             np.ones((1, 5), np.float32)])[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    finally:
+        serving.stop()
