@@ -134,17 +134,26 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q,
-                  block_k, num_k_blocks, causal, q_offset=0, with_lse=False):
+                  block_k, num_k_blocks, causal, head_dim, q_offset=0,
+                  with_lse=False):
     """Grid = (batch*heads, num_q_blocks, num_k_blocks); the k dim is innermost
-    so (acc, m, l) scratch carries the online softmax across k iterations.
+    so (acc, m) scratch carries the online softmax across k iterations.
     With ``with_lse`` the kernel also emits the log2-domain logsumexp
-    (m + log2 l) per q row, which the Pallas backward consumes."""
+    (m + log2 l) per q row, which the Pallas backward consumes.
+
+    ``v_ref`` arrives AUGMENTED with a trailing ones column
+    (_flash_forward), so the p @ v matmul computes the softmax normalizer
+    l = sum(p) in its last output column for free: at D=64 the matmul's N
+    dim uses half the MXU lanes anyway, and the separate sum(p) reduction
+    was one of the (block_q, block_k) VPU passes this VPU-bound kernel is
+    made of. acc's last column carries l (the rescale correction applies
+    to it identically)."""
     import jax.experimental.pallas as pl  # local import keeps module cpu-safe
 
     if with_lse:
-        lse_ref, acc_ref, m_ref, l_ref = rest
+        lse_ref, acc_ref, m_ref = rest
     else:
-        acc_ref, m_ref, l_ref = rest
+        acc_ref, m_ref = rest
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
 
@@ -152,7 +161,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q,
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
 
     q_start = q_idx * block_q
     k_start = k_idx * block_k
@@ -182,10 +190,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp2(s - m_new)                          # (block_q, block_k)
         correction = jnp.exp2(m_prev - m_new)            # (block_q, 1)
-        l_ref[...] = (l_ref[...] * correction +
-                      jnp.sum(p, axis=-1, keepdims=True))
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        v = v_ref[0]
+        v = v_ref[0]                                     # (block_k, D+1)
         acc_ref[...] = (acc_ref[...] * correction +
                         jnp.dot(p.astype(v.dtype), v,
                                 preferred_element_type=jnp.float32))
@@ -204,11 +210,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q,
 
     @pl.when(k_idx == num_k_blocks - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        acc = acc_ref[...]
+        l = jnp.maximum(acc[:, head_dim:head_dim + 1], 1e-30)
+        o_ref[0] = (acc[:, :head_dim] / l).astype(o_ref.dtype)
         if with_lse:
             # p_ij = exp2(s2_ij - L2_i) with L2 = m + log2 l (log2 domain)
             lse_ref[0] = m_ref[:, :1] + jnp.log2(l)
+
+
+@functools.lru_cache(maxsize=1)
+def _mosaic_params():
+    """Grid dimension semantics for all three flash kernels: dims 0/1
+    (batch*heads and the non-carry sequence dim) are parallel, the
+    innermost dim carries online-softmax / accumulator state and must stay
+    ordered. Parallel dims let Mosaic overlap the next tile's DMA with the
+    current tile's compute instead of treating the whole grid as one
+    sequential loop."""
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _input_vma(arrays):
@@ -239,6 +259,10 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     qf = jnp.moveaxis(qf, 2, 1).reshape(b * h, s_q, d)
     kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
     vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+    # ones column: p @ [v | 1] yields the softmax normalizer in the last
+    # output column on the MXU (free at D=64 — see _flash_kernel)
+    vf = jnp.concatenate(
+        [vf, jnp.ones((b * h, s_k, 1), vf.dtype)], axis=-1)
 
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
@@ -248,8 +272,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     grid = (b * h, num_q, num_k)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k,
-        num_k_blocks=num_k, causal=causal, q_offset=s_k - s_q,
-        with_lse=with_lse)
+        num_k_blocks=num_k, causal=causal, head_dim=d,
+        q_offset=s_k - s_q, with_lse=with_lse)
     # Under shard_map (e.g. Ulysses sequence parallelism) the output must
     # declare which mesh axes it varies over. Use the union of the inputs'
     # varying sets and lift any less-varying input up to it so mixed-vma
@@ -271,15 +295,20 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d + 1),
+                         lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d + 1), jnp.float32),   # acc | l column
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        # bh/q grid dims carry no state between steps — declaring them
+        # parallel lets Mosaic double-buffer the next tile's DMA behind
+        # this tile's compute; only the k dim (online-softmax carry) is
+        # order-dependent
+        compiler_params=None if interpret else _mosaic_params(),
         interpret=interpret,
     )(qf, kf, vf)
     out = jnp.moveaxis(res[0].reshape(b, h, s_q, d), 1, 2)
@@ -472,6 +501,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
         out_specs=pl.BlockSpec((1, bq, d), lambda bhi, qi, ki: (bhi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=None if interpret else _mosaic_params(),
         interpret=interpret,
     )(q2, kf, vf, gf, lse, D)
 
@@ -500,6 +530,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=None if interpret else _mosaic_params(),
         interpret=interpret,
     )(q2, kf, vf, gf, lse, D)
 

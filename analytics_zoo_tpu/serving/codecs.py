@@ -55,7 +55,9 @@ class SparseTensor:
     def to_dense(self) -> np.ndarray:
         out = np.zeros(self.shape, dtype=self.data.dtype)
         if len(self.data):
-            out[tuple(self.indices.T)] = self.data
+            # np.add.at: duplicate coordinates SUM (un-coalesced COO
+            # convention) instead of silently keeping the last value
+            np.add.at(out, tuple(self.indices.T), self.data)
         return out
 
 

@@ -112,12 +112,13 @@ class ClusterServing:
                 stacked = [np.stack([a[i] for a in arrays])
                            for i in range(len(first))]
             elif isinstance(first, dict):
-                # named multi-tensor records: stack per key, feed the model
-                # positionally in the record's key order (the reference's
-                # LinkedHashMap instances preserve order the same way,
-                # http/domains.scala:102)
+                # named multi-tensor records: stack per key (values fetched
+                # BY NAME per record), feed the model positionally in
+                # SORTED key order — deterministic across batches, unlike
+                # first-record insertion order, which would swap model
+                # inputs whenever differently-ordered clients co-batch
                 stacked = [np.stack([a[k] for a in arrays])
-                           for k in first.keys()]
+                           for k in sorted(first.keys())]
             else:
                 stacked = np.stack(arrays)
         with self.timer.time("inference"):

@@ -84,22 +84,27 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
             return web.json_response({"error": "missing 'instances' list"},
                                      status=400)
         loop = asyncio.get_running_loop()
-        uris = []
+        # parse + validate EVERY instance before enqueuing any: a malformed
+        # instance mid-list must 400 without having orphaned earlier
+        # instances' work/results on the broker
+        parsed = []
         for inst in instances:
-            uri = uuid.uuid4().hex
             try:
                 if isinstance(inst, dict):
                     named = {k: _parse_tensor_value(v)
                              for k, v in inst.items()}
-                    data = (next(iter(named.values()))
-                            if len(named) == 1 else named)
+                    parsed.append(next(iter(named.values()))
+                                  if len(named) == 1 else named)
                 else:
-                    data = np.asarray(inst, dtype=np.float32)
+                    parsed.append(np.asarray(inst, dtype=np.float32))
             except (ValueError, TypeError) as e:
                 # malformed instance (bad sparse triple, ragged list):
                 # client error, not a 500
                 return web.json_response(
                     {"error": f"bad instance: {e}"}, status=400)
+        uris = []
+        for data in parsed:
+            uri = uuid.uuid4().hex
             broker.enqueue(uri, encode_payload(data, meta={"uri": uri}))
             uris.append(uri)
 
@@ -122,16 +127,15 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         """Store the secret/salt an encrypted model artifact is sealed with
         (reference FrontEndApp.scala:145-157 posts them to redis; here they
         land in app state for the embedded worker / operator to read).
-        Body: ``secret=xxx&salt=yyy`` like the reference."""
-        content = await request.text()
-        try:
-            parts = dict(kv.split("=", 1) for kv in content.split("&"))
-            app["model_secret"] = parts["secret"]
-            app["model_salt"] = parts["salt"]
-        except (ValueError, KeyError):
+        Body: ``secret=xxx&salt=yyy`` like the reference (form-decoded, so
+        percent-encoded secrets survive)."""
+        form = await request.post()
+        if "secret" not in form or "salt" not in form:
             return web.json_response(
                 {"error": "please post a content like secret=xxx&salt=yyy"},
                 status=400)
+        app["model_secret"] = form["secret"]
+        app["model_salt"] = form["salt"]
         return web.Response(text="model secured secret and salt succeed "
                                  "to put in app state")
 

@@ -526,7 +526,8 @@ def test_frontend_auth_and_sparse(orca_context):
                     headers=hdr)
                 preds = (await r4.json())["predictions"]
                 r5 = await client.post("/model-secure",
-                                       data="secret=abc&salt=xyz",
+                                       data={"secret": "a+b/c=",
+                                             "salt": "xyz"},
                                        headers=hdr)
                 return (r0.status, r1.status, r2.status, r3.status,
                         r4.status, preds, r5.status,
@@ -536,7 +537,7 @@ def test_frontend_auth_and_sparse(orca_context):
             asyncio.new_event_loop().run_until_complete(run())
         assert (s0, s1, s2, s3, s4, s5) == (200, 401, 401, 200, 200, 200)
         assert len(preds) == 1 and len(preds[0]) == 3
-        assert (sec, salt) == ("abc", "xyz")
+        assert (sec, salt) == ("a+b/c=", "xyz")  # form-decoded intact
     finally:
         serving.stop()
 
