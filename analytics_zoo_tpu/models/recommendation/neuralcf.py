@@ -86,6 +86,50 @@ class NeuralCF(ZooModel):
             compute_dtype=compute_dtype)
         super().__init__(module)
 
+    @staticmethod
+    def migrate_legacy_state(state: dict) -> tuple:
+        """Convert a pre-round-4 checkpoint (separate ``mlp_*_embed`` /
+        ``mf_*_embed`` nn.Embed tables) to the fused
+        ``user_embed_table``/``item_embed_table`` layout introduced for
+        the MXU embedding path. Returns (migrated?, new_state); optimizer
+        moments cannot be migrated across the structural change, so the
+        caller reinitializes them (round-4 advisor finding)."""
+        import numpy as np
+        params = state.get("params", {})
+        if "user_embed_table" in params or "mlp_user_embed" not in params:
+            return False, state
+        new = dict(params)
+        u = np.asarray(new.pop("mlp_user_embed")["embedding"])
+        i = np.asarray(new.pop("mlp_item_embed")["embedding"])
+        if "mf_user_embed" in new:
+            u = np.concatenate(
+                [u, np.asarray(new.pop("mf_user_embed")["embedding"])], 1)
+            i = np.concatenate(
+                [i, np.asarray(new.pop("mf_item_embed")["embedding"])], 1)
+        new["user_embed_table"] = u
+        new["item_embed_table"] = i
+        return True, dict(state, params=new)
+
+    def load(self, path: str):
+        """Load an estimator checkpoint pickle, accepting both the fused
+        layout and pre-round-4 per-branch checkpoints (migrated on the
+        fly; a migrated load restarts the optimizer moments)."""
+        import pickle as _pickle
+
+        import logging
+        est = self.estimator
+        with open(path, "rb") as f:
+            state = _pickle.load(f)
+        migrated, state = self.migrate_legacy_state(state)
+        if migrated:
+            state["opt_state"] = est.engine.tx.init(state["params"])
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "migrated pre-fusion NeuralCF checkpoint: embedding tables "
+                "concatenated into the fused layout; optimizer state "
+                "reinitialized")
+        est.engine.set_state(state)
+        return self
+
     def recommend_for_user(self, user_item_pairs, max_items: int = 5):
         """Rank candidate items per user from predicted click prob
         (reference Recommender.recommend_for_user,

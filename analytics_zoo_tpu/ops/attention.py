@@ -442,6 +442,25 @@ def _flash_bwd_dkv_kernel(q2_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _bwd_tile_sizes(s_q: int, s_k: int, block_q: int, block_k: int):
+    """Backward tile sizes: the backward keeps ~4 (bq, bk) f32 tiles +
+    operands live per grid step; 1024x1024 f32 blows the 16M VMEM scoped
+    limit, so halve down to <=512. An ODD user block > 512 that divides S
+    halves to a non-divisor and would silently drop the trailing rows of
+    dq/dk/dv (round-4 advisor) — re-fit via gcd with 512 (the largest
+    power-of-two tile <= 512 that divides S)."""
+    bq, bk = min(block_q, s_q), min(block_k, s_k)
+    while bq > 512:
+        bq //= 2
+    while bk > 512:
+        bk //= 2
+    if s_q % bq:
+        bq = math.gcd(s_q, 512)
+    if s_k % bk:
+        bk = math.gcd(s_k, 512)
+    return bq, bk
+
+
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     """FlashAttention-2-style Pallas backward: a dQ kernel (k innermost)
     and a dK/dV kernel (q innermost), both consuming the forward's
@@ -457,14 +476,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     interpret = not _on_tpu()
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    bq, bk = min(block_q, s_q), min(block_k, s_k)
-    # the backward keeps ~4 (bq, bk) f32 tiles + operands live per grid
-    # step; 1024x1024 f32 blows the 16M VMEM scoped limit — halve down to
-    # <=512 (divisibility holds: 512 divides anything 1024+ blocks divide)
-    while bq > 512:
-        bq //= 2
-    while bk > 512:
-        bk //= 2
+    bq, bk = _bwd_tile_sizes(s_q, s_k, block_q, block_k)
     nq, nk = s_q // bq, s_k // bk
     bh = b * h
     cd = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32

@@ -100,9 +100,13 @@ def embedding_lookup(table: jax.Array, ids: jax.Array, *,
     if grad_mode not in ("auto", "onehot", "scatter"):
         raise ValueError(f"unknown grad_mode {grad_mode!r}")
     rows, cols = table.shape[0], int(np.prod(table.shape[1:]))
-    use_onehot = (grad_mode == "onehot" or
-                  (grad_mode == "auto" and rows <= onehot_rows_max
-                   and rows * cols <= ONEHOT_ELEMENTS_MAX))
+    # the one-hot backward reshapes g to (-1, last_dim), which only lines
+    # up with the one-hot's leading dim for 2-D tables — an N-D table
+    # would trace-fail with an opaque dot_general error (round-4 advisor)
+    use_onehot = (table.ndim == 2 and
+                  (grad_mode == "onehot" or
+                   (grad_mode == "auto" and rows <= onehot_rows_max
+                    and rows * cols <= ONEHOT_ELEMENTS_MAX)))
     if use_onehot:
         return _make_onehot_lookup(table.shape[0],
                                    jnp.dtype(table.dtype).name)(table, ids)
