@@ -159,21 +159,37 @@ class ObjectDetector(ZooModel):
 
     def as_inference_model(self, score_threshold: float = 0.05,
                            nms_threshold: float = 0.45,
-                           max_detections: int = 100):
+                           max_detections: int = 100,
+                           serve_dtype=None):
         """Wrap the trained detector as an :class:`InferenceModel` whose
         ``predict`` returns decoded (label, score, box) detections — the unit
         ClusterServing serves (BASELINE config #5: object-detection serving).
         The SSD forward and the NMS postprocessor fuse into one XLA program
-        per batch bucket."""
+        per batch bucket.
+
+        ``serve_dtype``: compute dtype for the conv trunk (default bf16 on
+        TPU — the SSD modules key their compute dtype off the input dtype,
+        and serving ingress sends f32 images, which would otherwise run
+        the whole trunk at the MXU's much slower f32 rate). Box decode/NMS
+        stay f32."""
+        import jax
+        import jax.numpy as jnp
+
         from ....pipeline.inference.inference_model import InferenceModel
 
+        if serve_dtype is None:
+            serve_dtype = (jnp.bfloat16
+                           if jax.default_backend() == "tpu"
+                           else jnp.float32)
         ssd_module, priors = self.module, self.priors
 
         class _Servable:
             def apply(self, variables, x):
-                loc, conf = ssd_module.apply(variables, x)
+                loc, conf = ssd_module.apply(variables,
+                                             x.astype(serve_dtype))
                 return decode_detections(
-                    loc, conf, priors, score_threshold=score_threshold,
+                    loc.astype(jnp.float32), conf.astype(jnp.float32),
+                    priors, score_threshold=score_threshold,
                     nms_threshold=nms_threshold,
                     max_detections=max_detections)
 

@@ -603,27 +603,80 @@ def bench_serving_od(smoke: bool) -> dict:
 
     size = 64 if smoke else 128
     n_req = 64 if smoke else 512
-    batch = 16
+    # bucket sized to the model: tiny-SSD convs at batch 16 leave the chip
+    # idle between launches; 64 quadruples per-dispatch parallelism and is
+    # still a 12 MB batch (r5)
+    batch = 16 if smoke else 64
     det = ObjectDetector(class_names=("a", "b", "c"), image_size=size,
                          model_type="ssd_tiny", max_gt=4)
     det.compile()
+    # serve in bf16 (the detector's default on TPU): serving ingress sends
+    # f32 images, which would otherwise run the conv trunk at f32 rate
     model = det.as_inference_model(max_detections=20)
     rng = np.random.RandomState(0)
     imgs = rng.rand(n_req, size, size, 3).astype(np.float32)
 
-    # compute-side: jitted apply on a device-resident full batch
-    jit_apply = jax.jit(model._apply_fn)
+    # compute-side: chained inside one jit (per-dispatch platform overhead
+    # is ms-scale here — docs/performance_notes.md round-5 notes), input
+    # perturbed by the previous iteration's output so iterations serialize
+    import jax.numpy as jnp
+    repeat = 4 if smoke else 8
+
+    @jax.jit
+    def apply_chain(variables, x):
+        def body(i, carry):
+            x2, acc = carry
+            out = model._apply_fn(variables, x2)
+            bump = jax.tree_util.tree_leaves(out)[0].astype(
+                jnp.float32).sum() * 1e-20
+            return (x + bump, acc + bump)
+        return jax.lax.fori_loop(
+            0, repeat, body, (x, jnp.zeros((), jnp.float32)))[1]
+
     dev_in = jax.device_put(imgs[:batch])
-    np.asarray(jit_apply(model._variables, dev_in))   # compile
-    steps = 5 if smoke else 30
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = jit_apply(model._variables, dev_in)
-    np.asarray(jax.tree_util.tree_leaves(out)[0])
-    dt_compute = (time.perf_counter() - t0) / steps
+    float(apply_chain(model._variables, dev_in))   # compile
+    best = float("inf")
+    pipeline = 3
+    for _ in range(3 if smoke else 5):
+        t0 = time.perf_counter()
+        for _ in range(pipeline):
+            o = apply_chain(model._variables, dev_in)
+        float(o)
+        best = min(best, (time.perf_counter() - t0))
+    dt_compute = best / (repeat * pipeline)
     comp = batch / dt_compute
+    jit_apply = jax.jit(model._apply_fn)
     step_flops = _step_flops(jit_apply, (model._variables, imgs[:batch]), 0.0)
     peak_rate = sum(_peak_flops(d) for d in jax.devices())
+
+    # conv-trunk probe (same chained discipline, no decode/NMS): the
+    # roofline for this model is NOT the dense-matmul peak — tiny-SSD
+    # convs carry <=64 channels, so the 128x128 MXU runs half-empty by
+    # shape, on top of XLA's conv-emitter efficiency (perf notes round 2:
+    # representative convs reach 6-9% of nominal even dispatch-free).
+    # trunk_ms vs full_ms also shows what decode/NMS adds.
+    ssd_mod, eng = det.module, det.estimator.engine
+    trunk_vars = {"params": eng.params, **eng.extra_vars}
+
+    @jax.jit
+    def trunk_chain(v, x):
+        def body(i, carry):
+            x2, acc = carry
+            loc, _ = ssd_mod.apply(v, x2.astype(jnp.bfloat16))
+            bump = loc.astype(jnp.float32).sum() * 1e-20
+            return (x + bump, acc + bump)
+        return jax.lax.fori_loop(
+            0, repeat, body, (x, jnp.zeros((), jnp.float32)))[1]
+
+    float(trunk_chain(trunk_vars, dev_in))
+    tbest = float("inf")
+    for _ in range(3 if smoke else 5):
+        t0 = time.perf_counter()
+        for _ in range(pipeline):
+            o = trunk_chain(trunk_vars, dev_in)
+        float(o)
+        tbest = min(tbest, (time.perf_counter() - t0))
+    dt_trunk = tbest / (repeat * pipeline)
 
     broker = InMemoryBroker()
     serving = ClusterServing(model, queue=broker, batch_size=batch,
@@ -675,6 +728,14 @@ def bench_serving_od(smoke: bool) -> dict:
                             "publishes no absolute number",
            "mfu_compute": (round(step_flops / dt_compute / peak_rate, 4)
                            if peak_rate and step_flops else None),
+           "trunk_records_per_sec": round(batch / dt_trunk, 1),
+           "decode_nms_ms_per_batch": round(
+               (dt_compute - dt_trunk) * 1e3, 2),
+           "serve_dtype": "bfloat16",
+           "roofline_note": ("tiny-SSD convs carry <=64 channels so the "
+                             "128-wide MXU runs half-empty by shape; the "
+                             "conv trunk alone is the model's floor — see "
+                             "docs/performance_notes.md round-5"),
            "e2e_records_per_sec": round(per_sec, 1),
            "e2e_tunnel_limited": bool(hot_mbps < 200.0),
            "hot_transfer_MBps": round(hot_mbps, 1),
