@@ -909,9 +909,79 @@ def bench_attention(smoke: bool) -> dict:
             **long_seq}
 
 
+def bench_real_host() -> int:
+    """One-command e2e recipe for a REAL (direct-attached) TPU host.
+
+    The dev environment reaches its chip through a tunnel whose
+    host->device bandwidth (7-50 MB/s measured) binds every streamed
+    number, so the e2e BASELINE metrics (samples/sec through the real
+    input pipeline) cannot be demonstrated here — only their compute-side
+    ceilings. This mode is the recipe for the first operator with a
+    direct-attached TPU host (PCIe/DMA, GB/s-class): it gates on measured
+    transfer bandwidth, then runs ResNet-50 and NCF end-to-end with the
+    production input path (InfeedPump prefetch + scan-fused dispatch) and
+    writes BENCH_REALHOST.json. On a tunneled host it writes the artifact
+    with ok=false and the measured bandwidth, and exits 1 with a clear
+    message — the artifact schema is the point, so the first real-host
+    run is one command: ``python bench.py --real-host``.
+    """
+    import jax
+    import jax.numpy as jnp
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_REALHOST.json")
+    # gate on transfer bandwidth UNDER LOAD: the tunnel bursts GB/s-class
+    # when the chip is idle but collapses to tens of MB/s with live
+    # compute on the queue — exactly the condition every training step's
+    # infeed runs in. Queue a long matmul chain, then time the transfer.
+    @jax.jit
+    def _busy(a):
+        return jax.lax.fori_loop(0, 16, lambda i, acc: acc @ a, a)
+    mm = jax.device_put(jnp.ones((8192, 8192), jnp.bfloat16))
+    float(_busy(mm)[0, 0].astype(jnp.float32))      # compile
+    probe = np.zeros((32 << 20) // 4, np.float32)   # 32 MB
+    pending = _busy(mm)                              # occupy the chip
+    mbps = _hot_mbps(probe)
+    float(pending[0, 0].astype(jnp.float32))
+    artifact = {
+        "transfer_MBps": round(mbps, 1),
+        "transfer_gate_MBps": 1000.0,
+        "devices": [getattr(d, "device_kind", str(d))
+                    for d in jax.devices()],
+        "ok": bool(mbps >= 1000.0),
+    }
+    if mbps < 1000.0:
+        artifact["reason"] = (
+            f"host->device transfer measured {mbps:.0f} MB/s (< 1 GB/s): "
+            "this host reaches its TPU through a tunnel or degraded "
+            "link, so end-to-end streamed numbers would measure the "
+            "link, not the framework. Run on a TPU VM with "
+            "direct-attached chips (docs/deploy_tpu_vm.md).")
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps(artifact))
+        print(f"\n--real-host: {artifact['reason']}", file=sys.stderr)
+        return 1
+    # real host: run the two north-star e2e workloads with the production
+    # input path; their streamed `value` fields are the BASELINE numbers
+    artifact["resnet50"] = bench_resnet50(smoke=False)
+    artifact["ncf"] = bench_ncf(smoke=False)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "metric": "real_host_e2e",
+        "value": artifact["resnet50"]["value"],
+        "unit": "samples/sec/chip",
+        "vs_baseline": artifact["resnet50"]["vs_baseline"],
+        "ncf_value": artifact["ncf"]["value"],
+        "transfer_MBps": artifact["transfer_MBps"], "ok": True}))
+    return 0
+
+
 def main():
     from analytics_zoo_tpu import init_orca_context
     init_orca_context("local")
+    if "--real-host" in sys.argv:
+        sys.exit(bench_real_host())
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
     only = os.environ.get("BENCH_ONLY", "").split(",") if \
         os.environ.get("BENCH_ONLY") else None
