@@ -2,12 +2,28 @@
 
 Beyond-parity axis (the reference scales only in the batch dimension,
 SURVEY §2.3): a stack of S homogeneous stages (e.g. transformer blocks)
-is sharded one-stage-per-pp-rank, the batch is split into M microbatches,
-and activations flow stage→stage over ICI via ``ppermute`` inside a
-``lax.scan`` of M + S - 1 ticks (the classic GPipe schedule; bubble
-fraction (S-1)/(M+S-1)). Everything is differentiable — ``ppermute``'s
-transpose is the reverse rotation — so one ``jax.grad`` over the pipelined
-forward trains all stages.
+is sharded over pp ranks — S may be a MULTIPLE of the pp size, in which
+case each rank runs its contiguous block of S/pp stages back to back per
+tick — the batch is split into M microbatches, and activations flow
+rank→rank over ICI via ``ppermute`` inside a ``lax.scan`` of
+M + pp - 1 ticks (the classic GPipe schedule; bubble fraction
+(pp-1)/(M+pp-1)). Everything is differentiable — ``ppermute``'s
+transpose is the reverse rotation — so one ``jax.grad`` over the
+pipelined forward trains all stages.
+
+Schedule note (GPipe vs 1F1B): reverse-mode AD of the scanned forward
+yields GPipe's all-forwards-then-all-backwards order, whose peak
+activation memory grows with M. ``remat=True`` (default) wraps each
+stage application in ``jax.checkpoint`` so the scan stores only
+stage INPUTS and recomputes internals during the backward — the GPipe
+paper's own configuration, bringing residuals to O(M) microbatch
+activations per rank. A true 1F1B schedule would cap that at O(pp)
+in-flight microbatches instead of O(M), at the cost of hand-scheduling
+the backward interleave outside ``jax.grad``; with remat on and the
+typical M ≈ 4·pp, the memory delta is ~4x on activations only (params/
+optimizer dominate at scale), so GPipe+remat is this framework's v1
+training schedule and the bubble/memory tradeoff is: bubble
+(pp-1)/(M+pp-1) shrinks with M while activation residuals grow with M.
 
 Functional surface (flax-module-agnostic):
 
@@ -47,51 +63,66 @@ def stage_sharding(mesh: Mesh, stacked: Any, axis: str = "pp") -> Any:
 
 def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jax.Array,
                    *, mesh: Mesh, microbatches: int,
-                   axis: str = "pp") -> jax.Array:
+                   axis: str = "pp", remat: bool = True) -> jax.Array:
     """Run ``x`` through S pipelined stages; returns the final stage's
     output, replicated across the ``pp`` axis.
 
-    x: (B, ...) with B % microbatches == 0. Stage count S = mesh.shape
-    [axis]; the stacked params' leading axis must equal S.
-    """
-    s_count = mesh.shape[axis]
+    x: (B, ...) with B % microbatches == 0. The stacked params' leading
+    stage axis S must be a multiple of mesh.shape[axis]; each rank runs
+    its contiguous block of S/pp stages sequentially per tick.
+    ``remat=True`` checkpoints each stage application so the backward
+    recomputes stage internals instead of storing them (see module
+    docstring for the schedule/memory tradeoff)."""
+    pp = mesh.shape[axis]
     leading = {l.shape[0] for l in jax.tree_util.tree_leaves(stacked_params)}
-    if leading != {s_count}:
+    if len(leading) != 1:
         raise ValueError(
-            f"stacked params' leading stage axis {sorted(leading)} must "
-            f"equal the '{axis}' mesh axis size {s_count} — shard_map "
-            "would otherwise silently slice away stages")
+            f"stacked params disagree on the stage axis: {sorted(leading)}")
+    s_total = leading.pop()
+    if s_total % pp:
+        raise ValueError(
+            f"stage count {s_total} must be a multiple of the '{axis}' "
+            f"mesh axis size {pp} — shard_map would otherwise silently "
+            "slice away stages")
     b = x.shape[0]
     if b % microbatches:
         raise ValueError(f"batch {b} not divisible by microbatches "
                          f"{microbatches}")
     mb = b // microbatches
     xs = x.reshape(microbatches, mb, *x.shape[1:])
+    apply_stage = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def pp_body(params, xs_local):
-        # params: this rank's stage slice, leading axis 1 -> squeeze
-        params = jax.tree_util.tree_map(lambda l: l[0], params)
+        # params: this rank's contiguous block of S/pp stages
         rank = lax.axis_index(axis)
-        ticks = microbatches + s_count - 1
+        ticks = microbatches + pp - 1
         zero = jnp.zeros_like(xs_local[0])
+
+        def run_block(p_block, inp):
+            # apply this rank's stages in order (scan over the leading
+            # per-rank stage axis; a single stage still goes through it)
+            def body(c, p):
+                return apply_stage(p, c), None
+            out, _ = lax.scan(body, inp, p_block)
+            return out
 
         def tick(carry, t):
             recv, outs = carry
-            # stage 0 injects microbatch t (while t < M); later stages
-            # consume what the previous stage sent last tick
+            # rank 0 injects microbatch t (while t < M); later ranks
+            # consume what the previous rank sent last tick
             feed_idx = jnp.minimum(t, microbatches - 1)
             inject = lax.dynamic_index_in_dim(xs_local, feed_idx, 0,
                                               keepdims=False)
             inp = jnp.where(rank == 0,
                             jnp.where(t < microbatches, inject, zero),
                             recv)
-            out = stage_fn(params, inp)
-            # rotate activations one stage forward
-            perm = [(i, (i + 1) % s_count) for i in range(s_count)]
+            out = run_block(params, inp)
+            # rotate activations one rank forward
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
             recv_next = lax.ppermute(out, axis, perm)
-            # last stage banks microbatch t-(S-1) when it's live
-            out_idx = t - (s_count - 1)
-            live = jnp.logical_and(rank == s_count - 1, out_idx >= 0)
+            # last rank banks microbatch t-(pp-1) when it's live
+            out_idx = t - (pp - 1)
+            live = jnp.logical_and(rank == pp - 1, out_idx >= 0)
             outs = lax.cond(
                 live,
                 lambda o: lax.dynamic_update_index_in_dim(
@@ -101,9 +132,9 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any, x: jax.Array,
 
         init = (zero, jnp.zeros_like(xs_local))
         (_, outs), _ = lax.scan(tick, init, jnp.arange(ticks))
-        # replicate the last stage's banked outputs across pp: every other
+        # replicate the last rank's banked outputs across pp: every other
         # rank holds zeros, so a psum broadcasts without a gather
-        mask = jnp.where(lax.axis_index(axis) == s_count - 1, 1.0, 0.0)
+        mask = jnp.where(lax.axis_index(axis) == pp - 1, 1.0, 0.0)
         return lax.psum(outs * mask.astype(outs.dtype), axis)
 
     param_specs = jax.tree_util.tree_map(

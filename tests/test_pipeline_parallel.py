@@ -85,13 +85,48 @@ def test_pipeline_rejects_ragged_microbatching():
         pipeline_apply(_stage_fn, stacked, x, mesh=mesh, microbatches=3)
 
 
-def test_pipeline_rejects_stage_count_mismatch():
-    """8 stages on a 4-rank pp mesh must raise, not silently run every
-    other stage (shard_map would slice the stage axis per rank)."""
+def test_pipeline_multi_stage_per_rank_matches_sequential():
+    """S = 2 x pp stages (two per rank, run back to back each tick) must
+    equal sequential application — forward and gradients (round 5)."""
     mesh = _mesh(4)
-    stacked = stack_stage_params(_stages(8, 4))
+    d, b, m = 8, 16, 4
+    stages = _stages(8, d, seed=4)
+    stacked = stack_stage_params(stages)
+    stacked = jax.device_put(stacked, stage_sharding(mesh, stacked))
+    x = jnp.asarray(np.random.RandomState(5).randn(b, d).astype(np.float32))
+
+    y = jax.jit(lambda p, x: pipeline_apply(
+        _stage_fn, p, x, mesh=mesh, microbatches=m))(stacked, x)
+    ref = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss_pp(p):
+        return jnp.sum(pipeline_apply(_stage_fn, p, x, mesh=mesh,
+                                      microbatches=m) ** 2)
+
+    def loss_seq(p):
+        xs = x
+        for i in range(8):
+            one = jax.tree_util.tree_map(lambda l: l[i], p)
+            xs = _stage_fn(one, xs)
+        return jnp.sum(xs ** 2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, bb in zip(jax.tree_util.tree_leaves(g_pp),
+                     jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_rejects_stage_count_mismatch():
+    """6 stages on a 4-rank pp mesh must raise (not a multiple — shard_map
+    would slice the stage axis unevenly)."""
+    mesh = _mesh(4)
+    stacked = stack_stage_params(_stages(6, 4))
     import pytest
-    with pytest.raises(ValueError, match="stage axis"):
+    with pytest.raises(ValueError, match="multiple"):
         pipeline_apply(_stage_fn, stacked, jnp.zeros((8, 4), jnp.float32),
                        mesh=mesh, microbatches=4)
 
