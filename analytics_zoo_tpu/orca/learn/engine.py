@@ -84,6 +84,7 @@ class TrainEngine:
         self._jit_train = None
         self._jit_train_multi = None
         self._jit_eval = None
+        self._jit_eval_multi = None
         self._jit_predict = None
         self._clip_norm: Optional[float] = None
         self._clip_min: Optional[float] = None
@@ -332,6 +333,32 @@ class TrainEngine:
             new_states[name] = m.update(metric_states[name], y0, preds, w)
         count = jnp.sum(w)
         return new_states, loss * count, count
+
+    def _eval_multi_step(self, params, extra, metric_states, xs, ys, ws):
+        """k fused eval steps in ONE dispatch (lax.scan over stacked
+        batches) — same dispatch-amortization as _train_multi_step, but
+        stateless apart from the metric accumulators, so fusing is always
+        semantics-preserving. Returns (states, loss_sum, count) with the
+        group's loss/count already summed."""
+        def body(carry, inp):
+            states, loss_sum, count = carry
+            x, y, w = inp
+            states, l, n = self._eval_step(params, extra, states, x, y, w)
+            return (states, loss_sum + l, count + n), None
+
+        init = (metric_states, jnp.zeros(()), jnp.zeros(()))
+        (states, loss_sum, count), _ = jax.lax.scan(body, init, (xs, ys, ws))
+        return states, loss_sum, count
+
+    def eval_batch_group(self, metric_states, batch: Batch):
+        """Fused-eval entry: batch carries stacked (k, local_batch, ...)
+        arrays. Returns (states, summed_loss, summed_count)."""
+        if self._jit_eval_multi is None:
+            self._jit_eval_multi = jax.jit(self._eval_multi_step,
+                                           donate_argnums=(2,))
+        return self._jit_eval_multi(self.params, self.extra_vars,
+                                    metric_states, batch.x, batch.y,
+                                    batch.w)
 
     def _predict_step(self, params, extra, x):
         preds, _ = self._apply(params, extra, x, False)

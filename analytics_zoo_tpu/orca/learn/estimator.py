@@ -222,17 +222,26 @@ class TPUEstimator:
             # steps_per_epoch keep the exact per-step loop
             return 1
         cfg = self.config.get("steps_per_dispatch", "auto")
-        row_bytes = sum(int(np.asarray(a[:1]).nbytes)
-                        for a in tuple(it.x) + tuple(it.y or ()))
-        batch_bytes = row_bytes * it.local_bs
+        batch_bytes = self._iter_batch_bytes(it)
         if cfg != "auto":
             k = max(1, int(cfg)) if cfg else 1
         elif it.steps_per_epoch < 2:
             return 1
         else:
             k = self._auto_probe_fuse(it, batch_bytes)
-        # caps shared by pinned and auto: superbatch memory, checkpoint
-        # cadence, epoch length
+        return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch,
+                                     trigger)
+
+    @staticmethod
+    def _iter_batch_bytes(it) -> int:
+        row_bytes = sum(int(np.asarray(a[:1]).nbytes)
+                        for a in tuple(it.x) + tuple(it.y or ()))
+        return row_bytes * it.local_bs
+
+    @staticmethod
+    def _apply_fuse_caps(k, batch_bytes, steps, trigger=None) -> int:
+        """Caps shared by the pinned and auto paths, for both train and
+        eval fusion: superbatch memory, checkpoint cadence, epoch length."""
         if batch_bytes > 0:
             byte_cap = max(learn_utils.MAX_GROUP_BYTES // batch_bytes, 1)
             if k > byte_cap:
@@ -245,7 +254,7 @@ class TPUEstimator:
         if isinstance(trigger, SeveralIteration):
             # keep the exact checkpoint cadence: never fuse past the interval
             k = min(k, trigger.interval)
-        return max(1, min(k, it.steps_per_epoch))
+        return max(1, min(k, steps))
 
     def _auto_probe_fuse(self, it, batch_bytes: int) -> int:
         """Time the pipelined dispatch loop with REAL train steps, then roll
@@ -457,14 +466,21 @@ class TPUEstimator:
             shuffle=False, config=self.config)
         sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
+        fuse = self._choose_eval_fuse(it, sample, num_steps)
         states = self.engine.init_metric_states()
         # accumulate device scalars; ONE device_get at the end so eval keeps
         # async dispatch going (fit() already works this way)
         losses, counts = [], []
-        for i, batch in enumerate(it.epoch(shuffle=False)):
+        for i, batch in enumerate(
+                it.epoch(shuffle=False, fuse=fuse) if fuse > 1
+                else it.epoch(shuffle=False)):
             if num_steps is not None and i >= num_steps:
                 break
-            states, batch_loss, n = self.engine.eval_batch(states, batch)
+            if getattr(batch, "fused", 1) > 1:
+                states, batch_loss, n = self.engine.eval_batch_group(
+                    states, batch)
+            else:
+                states, batch_loss, n = self.engine.eval_batch(states, batch)
             losses.append(batch_loss)
             counts.append(n)
         host_losses, host_counts = jax.device_get((losses, counts))
@@ -474,6 +490,60 @@ class TPUEstimator:
         if verbose:
             logger.info("validation: %s", result)
         return result
+
+    def _choose_eval_fuse(self, it, sample, num_steps) -> int:
+        """Fuse factor for evaluate(): eval is stateless apart from metric
+        accumulators, so fusing is always semantics-preserving — the probe
+        times real eval dispatches (chaining the donated metric states) and
+        discards the probe states. The probed k is cached per input
+        signature: fit(validation_data=...) evaluates every epoch and the
+        answer cannot change for the same model/shapes. ``num_steps`` pins
+        the per-step loop so explicit step counts stay exact."""
+        if not getattr(it, "supports_fused", False) or num_steps is not None \
+                or it.steps_per_epoch < 2:
+            return 1
+        cfg = self.config.get("steps_per_dispatch", "auto")
+        batch_bytes = self._iter_batch_bytes(it)
+        if cfg != "auto":
+            k = max(1, int(cfg)) if cfg else 1
+        else:
+            key = (it.local_bs,) + tuple(
+                (np.asarray(a[:1]).shape[1:], str(np.asarray(a[:1]).dtype))
+                for a in tuple(it.x) + tuple(it.y or ()))
+            cached = getattr(self, "_eval_fuse_cache", {}).get(key)
+            if cached is not None:
+                k = cached
+            else:
+                k = self._auto_probe_eval_fuse(it, sample, batch_bytes)
+                if not hasattr(self, "_eval_fuse_cache"):
+                    self._eval_fuse_cache = {}
+                self._eval_fuse_cache[key] = k
+        return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch)
+
+    def _auto_probe_eval_fuse(self, it, sample, batch_bytes: int) -> int:
+        import jax
+        eng = self.engine
+        states = eng.init_metric_states()
+        states, loss, _ = eng.eval_batch(states, sample)   # compile
+        jax.block_until_ready(loss)
+        compute_s = learn_utils.estimate_step_compute_s(
+            eng._jit_eval,
+            (eng.params, eng.extra_vars, states, sample.x, sample.y,
+             sample.w),
+            list(self.mesh.devices.flat))
+        if compute_s is not None and compute_s >= 0.01:
+            return 1
+        dt = float("inf")
+        m = 6
+        for _ in range(2):          # min-of-2 washes out contention spikes
+            t0 = time.perf_counter()
+            for _ in range(m):
+                states, loss, _ = eng.eval_batch(states, sample)
+            jax.block_until_ready(loss)
+            dt = min(dt, (time.perf_counter() - t0) / m)
+        return learn_utils.auto_fuse_factor(dt, it.steps_per_epoch,
+                                            batch_bytes=batch_bytes,
+                                            compute_s=compute_s)
 
     # --- predict ------------------------------------------------------------
     def predict(self, data, batch_size: int = 32, feature_cols=None,

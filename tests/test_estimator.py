@@ -324,3 +324,21 @@ def test_preemption_sigterm_checkpoints_and_stops(orca_context, tmp_path):
     est2.fit({"x": x, "y": y}, epochs=0, batch_size=32)   # build only
     est2.load_checkpoint(str(tmp_path))
     assert est2.engine.step == step_at_stop
+
+
+def test_fused_evaluate_matches_sequential(orca_context):
+    """evaluate() through the fused eval path must produce identical
+    metrics/loss to the per-batch loop (eval is stateless apart from the
+    metric accumulators, so fusing must be exactly semantics-preserving,
+    ragged tail included)."""
+    x, y = make_linear_data(64 * 5 + 17)
+    est = Estimator.from_keras(linear_model_creator, loss="mse",
+                               optimizer="sgd", metrics=["mae"])
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=64, verbose=False)
+    r_fused = est.evaluate({"x": x, "y": y}, batch_size=64, verbose=False)
+    est.config["steps_per_dispatch"] = 1
+    r_seq = est.evaluate({"x": x, "y": y}, batch_size=64, verbose=False)
+    assert r_fused["num_samples"] == r_seq["num_samples"] == 64 * 5 + 17
+    for k in r_seq:
+        np.testing.assert_allclose(r_fused[k], r_seq[k], rtol=1e-6,
+                                   atol=1e-7)
