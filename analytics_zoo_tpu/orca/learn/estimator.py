@@ -557,16 +557,21 @@ class TPUEstimator:
         it = learn_utils.BatchIterator(merged, batch_size, self.mesh,
                                        pad_tail=True)
         self.engine.build(tuple(np.asarray(a[:1]) for a in merged["x"]))
-        outs = []
+        # dispatch every batch first, fetch ONCE: a per-batch device_get
+        # would serialize each dispatch behind a host round trip (the same
+        # async-dispatch discipline fit()/evaluate() already follow)
+        pending = []
         for batch in it.epoch(shuffle=False):
-            preds = self.engine.predict_batch(batch.x)
-            pred_np = jax.device_get(preds)
-            if batch.w is None:                 # full batch, no padding
+            pending.append((self.engine.predict_batch(batch.x), batch.w))
+        fetched = jax.device_get(pending)
+        outs = []
+        for pred_np, w in fetched:
+            if w is None:                       # full batch, no padding
                 outs.append(tuple(np.asarray(p) for p in pred_np)
                             if isinstance(pred_np, (list, tuple))
                             else np.asarray(pred_np))
                 continue
-            mask = np.asarray(jax.device_get(batch.w)) > 0
+            mask = np.asarray(w) > 0
             if isinstance(pred_np, (list, tuple)):
                 outs.append(tuple(np.asarray(p)[mask] for p in pred_np))
             else:
