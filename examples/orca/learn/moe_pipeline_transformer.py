@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Mixture-of-experts + pipeline-parallel training demo.
+
+The reference scales only in the batch dimension (SURVEY.md §2.3); this
+demo shows the two round-5 beyond-parity axes working together in one
+training program on a virtual device mesh:
+
+* ep — Switch/GShard expert parallelism (parallel/expert_parallel.py):
+       E = 2 x ep experts (two resident per rank), top-2 routing with
+       renormalized gates, all-to-all token dispatch, load-balance aux
+       loss trained alongside the task loss.
+* pp — GPipe pipeline parallelism (parallel/pipeline_parallel.py):
+       S = 2 x pp stages (two per rank, run back to back per tick),
+       microbatched activations rotating over ppermute, per-stage remat.
+
+The model: a pipelined stack of dense blocks whose middle is an MoE
+layer, trained with one jax.grad over the whole schedule — gradients
+flow through the ppermute rotation AND the all-to-all dispatch.
+
+Usage (no TPU needed — run on the virtual CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/orca/learn/moe_pipeline_transformer.py
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--d-model", type=int, default=16)
+    p.add_argument("--tokens", type=int, default=64)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.steps = 4
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from analytics_zoo_tpu.parallel.expert_parallel import (
+        expert_sharding, moe_apply, stack_expert_params)
+    from analytics_zoo_tpu.parallel.pipeline_parallel import (
+        pipeline_apply, stack_stage_params, stage_sharding)
+
+    devs = jax.devices()
+    ep = pp = min(4, len(devs))
+    ep_mesh = Mesh(np.asarray(devs[:ep]).reshape(ep), ("ep",))
+    pp_mesh = Mesh(np.asarray(devs[:pp]).reshape(pp), ("pp",))
+    d = args.d_model
+    rng = np.random.RandomState(0)
+
+    # --- pipelined dense stages (2 per pp rank) ----------------------------
+    n_stages = 2 * pp
+    stages = [{"w": jnp.asarray(rng.randn(d, d).astype(np.float32) * 0.3),
+               "b": jnp.zeros((d,), jnp.float32)} for _ in range(n_stages)]
+    stage_params = stack_stage_params(stages)
+    stage_params = jax.device_put(stage_params,
+                                  stage_sharding(pp_mesh, stage_params))
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    # --- MoE layer: 2 experts per ep rank, top-2 routing -------------------
+    n_experts = 2 * ep
+    experts = [{"w1": jnp.asarray(rng.randn(d, 2 * d).astype(np.float32)
+                                  * 0.3),
+                "w2": jnp.asarray(rng.randn(2 * d, d).astype(np.float32)
+                                  * 0.3)} for _ in range(n_experts)]
+    expert_params = stack_expert_params(experts)
+    expert_params = jax.device_put(expert_params,
+                                   expert_sharding(ep_mesh, expert_params))
+    router = jnp.asarray(rng.randn(d, n_experts).astype(np.float32) * 0.1)
+
+    def expert_fn(params, tokens):
+        return jnp.tanh(tokens @ params["w1"]) @ params["w2"]
+
+    # --- data: learn to reproduce a random linear map ----------------------
+    n = args.tokens
+    x = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    w_true = rng.randn(d, d).astype(np.float32) * 0.5
+    y = jnp.asarray(np.tanh(np.asarray(x) @ w_true))
+
+    def forward(stage_p, expert_p, router_w, x):
+        h = pipeline_apply(stage_fn, stage_p, x, mesh=pp_mesh,
+                           microbatches=4)
+        moe_out, aux = moe_apply(expert_fn, expert_p, router_w, h,
+                                 mesh=ep_mesh, capacity_factor=2.0,
+                                 top_k=2)
+        return h + moe_out, aux        # residual around the MoE FFN
+
+    @jax.jit
+    def step(stage_p, expert_p, router_w, x, y):
+        def loss_fn(sp, epar, rw):
+            out, aux = forward(sp, epar, rw, x)
+            return jnp.mean((out - y) ** 2) + 0.01 * aux
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            stage_p, expert_p, router_w)
+        lr = 0.05
+        sp = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                    stage_p, grads[0])
+        epar = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                      expert_p, grads[1])
+        rw = router_w - lr * grads[2]
+        return sp, epar, rw, loss
+
+    first = last = None
+    for i in range(args.steps):
+        stage_params, expert_params, router, loss = step(
+            stage_params, expert_params, router, x, y)
+        loss = float(loss)
+        first = loss if first is None else first
+        last = loss
+        print(f"step {i}: loss {loss:.5f}")
+    assert np.isfinite(last), "training diverged"
+    assert last < first, "loss did not decrease through pp+ep gradients"
+    print(f"OK: {n_stages} pipelined stages over pp={pp} and "
+          f"{n_experts} experts (top-2) over ep={ep}; "
+          f"loss {first:.4f} -> {last:.4f}")
+
+
+if __name__ == "__main__":
+    main()

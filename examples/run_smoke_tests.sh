@@ -16,6 +16,7 @@ for script in \
     examples/orca/learn/resnet50_imagenet.py \
     examples/orca/learn/wide_and_deep_recommendation.py \
     examples/orca/learn/bert_pretrain_tp_sp.py \
+    examples/orca/learn/moe_pipeline_transformer.py \
     examples/orca/multihost_walkthrough.py \
     examples/nnframes/fraud_detection_mlp.py \
     examples/zouwu/autots_forecast.py \
