@@ -86,6 +86,10 @@ class TPUEstimator:
         self.train_stats: List[Dict[str, float]] = []
         self._tb_train = None
         self._tb_val = None
+        # probed eval fuse factor per input signature (fit with
+        # validation_data evaluates every epoch; the probe answer cannot
+        # change for the same model/shapes)
+        self._eval_fuse_cache: Dict = {}
 
     # --- gradient clipping (reference: orca/learn/tf/estimator.py
     # set_constant_gradient_clipping / set_l2_norm_gradient_clipping,
@@ -160,7 +164,9 @@ class TPUEstimator:
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         checkpoint_trigger = (Trigger.convert_trigger(checkpoint_trigger)
                               if checkpoint_trigger else None)
-        if hasattr(checkpoint_trigger, "arm"):
+        if checkpoint_trigger is not None:
+            # sync interval marks to the starting iteration (composites
+            # forward to children) so resumed runs fire on boundaries
             checkpoint_trigger.arm(self._trainer_state)
         # recovery is opted into by checkpointing (a trigger) or an explicit
         # retry count; a bare model_dir (often set just to control save()
@@ -221,16 +227,24 @@ class TPUEstimator:
             # custom iterators (streaming pipelines) and explicit
             # steps_per_epoch keep the exact per-step loop
             return 1
-        cfg = self.config.get("steps_per_dispatch", "auto")
+        cfg = self._fuse_cfg()
         batch_bytes = self._iter_batch_bytes(it)
         if cfg != "auto":
-            k = max(1, int(cfg)) if cfg else 1
+            k = cfg
         elif it.steps_per_epoch < 2:
             return 1
         else:
             k = self._auto_probe_fuse(it, batch_bytes)
         return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch,
                                      trigger)
+
+    def _fuse_cfg(self):
+        """steps_per_dispatch config, parsed once for fit and evaluate:
+        "auto" (default) or a pinned positive int (1 disables fusion)."""
+        cfg = self.config.get("steps_per_dispatch", "auto")
+        if cfg == "auto":
+            return "auto"
+        return max(1, int(cfg)) if cfg else 1
 
     @staticmethod
     def _iter_batch_bytes(it) -> int:
@@ -250,10 +264,11 @@ class TPUEstimator:
                     "superbatch stays under %dMB", k, byte_cap,
                     learn_utils.MAX_GROUP_BYTES >> 20)
                 k = byte_cap
-        from .trigger import SeveralIteration
-        if isinstance(trigger, SeveralIteration):
-            # keep the exact checkpoint cadence: never fuse past the interval
-            k = min(k, trigger.interval)
+        # keep checkpoint cadence exact: never fuse past the trigger's
+        # interval (composite triggers report their tightest child cap)
+        cap = trigger.fuse_cap() if trigger is not None else None
+        if cap:
+            k = min(k, cap)
         return max(1, min(k, steps))
 
     def _auto_probe_fuse(self, it, batch_bytes: int) -> int:
@@ -502,21 +517,17 @@ class TPUEstimator:
         if not getattr(it, "supports_fused", False) or num_steps is not None \
                 or it.steps_per_epoch < 2:
             return 1
-        cfg = self.config.get("steps_per_dispatch", "auto")
+        cfg = self._fuse_cfg()
         batch_bytes = self._iter_batch_bytes(it)
         if cfg != "auto":
-            k = max(1, int(cfg)) if cfg else 1
+            k = cfg
         else:
             key = (it.local_bs,) + tuple(
                 (np.asarray(a[:1]).shape[1:], str(np.asarray(a[:1]).dtype))
                 for a in tuple(it.x) + tuple(it.y or ()))
-            cached = getattr(self, "_eval_fuse_cache", {}).get(key)
-            if cached is not None:
-                k = cached
-            else:
+            k = self._eval_fuse_cache.get(key)
+            if k is None:
                 k = self._auto_probe_eval_fuse(it, sample, batch_bytes)
-                if not hasattr(self, "_eval_fuse_cache"):
-                    self._eval_fuse_cache = {}
                 self._eval_fuse_cache[key] = k
         return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch)
 
@@ -557,13 +568,22 @@ class TPUEstimator:
         it = learn_utils.BatchIterator(merged, batch_size, self.mesh,
                                        pad_tail=True)
         self.engine.build(tuple(np.asarray(a[:1]) for a in merged["x"]))
-        # dispatch every batch first, fetch ONCE: a per-batch device_get
-        # would serialize each dispatch behind a host round trip (the same
-        # async-dispatch discipline fit()/evaluate() already follow)
-        pending = []
+        # dispatch ahead, fetch in CHUNKS: per-batch device_get would
+        # serialize each dispatch behind a host round trip, but holding
+        # every batch's outputs on device until one final fetch would make
+        # predict's HBM footprint proportional to the dataset — chunked
+        # fetches keep async dispatch flowing with bounded residency
+        fetched = []
+        pending, pending_bytes = [], 0
         for batch in it.epoch(shuffle=False):
-            pending.append((self.engine.predict_batch(batch.x), batch.w))
-        fetched = jax.device_get(pending)
+            preds = self.engine.predict_batch(batch.x)
+            pending.append((preds, batch.w))
+            pending_bytes += sum(getattr(l, "nbytes", 0)
+                                 for l in jax.tree_util.tree_leaves(preds))
+            if pending_bytes >= (256 << 20):
+                fetched.extend(jax.device_get(pending))
+                pending, pending_bytes = [], 0
+        fetched.extend(jax.device_get(pending))
         outs = []
         for pred_np, w in fetched:
             if w is None:                       # full batch, no padding

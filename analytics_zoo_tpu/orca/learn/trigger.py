@@ -25,6 +25,17 @@ class Trigger:
     def __call__(self, state: TrainerState) -> bool:
         raise NotImplementedError
 
+    def arm(self, state: TrainerState) -> None:
+        """Sync any internal marks to the run's starting state (the
+        trainer calls this at fit() start). Default: stateless, no-op;
+        composites forward to their children."""
+
+    def fuse_cap(self):
+        """Max steps the trainer may fuse per dispatch without coarsening
+        this trigger's cadence (None = no constraint). Composites return
+        the tightest child cap."""
+        return None
+
     @staticmethod
     def convert_trigger(t) -> "Trigger":
         if isinstance(t, Trigger):
@@ -62,6 +73,9 @@ class SeveralIteration(Trigger):
         mid-interval, and a reused trigger on a fresh run must not stay
         dark until its old mark."""
         self._last_bucket = state.iteration // self.interval
+
+    def fuse_cap(self):
+        return self.interval
 
     def __call__(self, state):
         bucket = state.iteration // self.interval
@@ -110,17 +124,25 @@ class MinLoss(Trigger):
         return state.loss is not None and state.loss < self.min
 
 
-class TriggerAnd(Trigger):
+class _Composite(Trigger):
     def __init__(self, first: Trigger, *others: Trigger):
         self.triggers = (first,) + others
 
+    def arm(self, state):
+        for t in self.triggers:
+            t.arm(state)
+
+    def fuse_cap(self):
+        caps = [c for c in (t.fuse_cap() for t in self.triggers)
+                if c is not None]
+        return min(caps) if caps else None
+
+
+class TriggerAnd(_Composite):
     def __call__(self, state):
         return all(t(state) for t in self.triggers)
 
 
-class TriggerOr(Trigger):
-    def __init__(self, first: Trigger, *others: Trigger):
-        self.triggers = (first,) + others
-
+class TriggerOr(_Composite):
     def __call__(self, state):
         return any(t(state) for t in self.triggers)

@@ -112,13 +112,24 @@ class ClusterServing:
                 stacked = [np.stack([a[i] for a in arrays])
                            for i in range(len(first))]
             elif isinstance(first, dict):
-                # named multi-tensor records: stack per key (values fetched
-                # BY NAME per record), feed the model positionally in
-                # SORTED key order — deterministic across batches, unlike
-                # first-record insertion order, which would swap model
-                # inputs whenever differently-ordered clients co-batch
-                stacked = [np.stack([a[k] for a in arrays])
-                           for k in sorted(first.keys())]
+                # named multi-tensor records: stack per key (values
+                # fetched BY NAME per record) and feed the model
+                # positionally in the record's own key order — the
+                # reference's LinkedHashMap insertion-order semantics
+                # (http/domains.scala:102), i.e. clients declare tensors
+                # in the model's input order. Records that disagree on
+                # that order cannot be bound unambiguously: fail the
+                # batch with a clear message instead of silently feeding
+                # someone's tensors into the wrong inputs.
+                order = tuple(first.keys())
+                for a in arrays:
+                    if tuple(a.keys()) != order:
+                        raise ValueError(
+                            f"named-tensor records disagree on key order "
+                            f"({order} vs {tuple(a.keys())}); all clients "
+                            "of one stream must enqueue tensors in the "
+                            "model's input order")
+                stacked = [np.stack([a[k] for a in arrays]) for k in order]
             else:
                 stacked = np.stack(arrays)
         with self.timer.time("inference"):

@@ -342,3 +342,31 @@ def test_fused_evaluate_matches_sequential(orca_context):
     for k in r_seq:
         np.testing.assert_allclose(r_fused[k], r_seq[k], rtol=1e-6,
                                    atol=1e-7)
+
+
+def test_composite_trigger_cap_and_arm(orca_context, tmp_path):
+    """A SeveralIteration nested in TriggerOr must still cap the fuse
+    factor (checkpoint cadence preserved) and arm to the run's starting
+    iteration (round-5 review)."""
+    from analytics_zoo_tpu.orca.learn.trigger import (MinLoss, TriggerOr,
+                                                      TrainerState)
+    trig = TriggerOr(SeveralIteration(4), MinLoss(-1.0))  # MinLoss never
+    assert trig.fuse_cap() == 4
+    trig.arm(TrainerState(iteration=150))
+    assert not trig(TrainerState(iteration=151))   # mid-interval: no fire
+    assert trig(TrainerState(iteration=152))       # 152//4 > 150//4
+
+    import os
+    x, y = make_linear_data(512)
+    est = Estimator.from_keras(linear_model_creator, loss="mse",
+                               optimizer="sgd", model_dir=str(tmp_path),
+                               config={"steps_per_dispatch": 64})
+    est.fit({"x": x, "y": y}, epochs=2, batch_size=64,
+            checkpoint_trigger=TriggerOr(SeveralIteration(4),
+                                         MinLoss(-1.0)),
+            verbose=False)
+    ckpts = sorted(int(d.split("-")[1]) for d in os.listdir(tmp_path)
+                   if d.startswith("ckpt-"))
+    # fuse capped at the nested interval: checkpoints land every 4 steps,
+    # not once per 64-step dispatch
+    assert ckpts[-1] == 16 and len(ckpts) >= 4, ckpts
