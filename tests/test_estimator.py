@@ -370,3 +370,24 @@ def test_composite_trigger_cap_and_arm(orca_context, tmp_path):
     # fuse capped at the nested interval: checkpoints land every 4 steps,
     # not once per 64-step dispatch
     assert ckpts[-1] == 16 and len(ckpts) >= 4, ckpts
+
+
+def test_fit_with_validation_uses_cached_eval_fuse(orca_context):
+    """fit(validation_data=...) evaluates every epoch; the eval fuse
+    probe must run once and be cached, and val metrics must appear in the
+    epoch stats."""
+    x, y = make_linear_data(512)
+    est = Estimator.from_keras(linear_model_creator, loss="mse",
+                               optimizer="sgd", metrics=["mae"])
+    calls = {"n": 0}
+    real_probe = est._auto_probe_eval_fuse
+
+    def counting_probe(*a, **kw):
+        calls["n"] += 1
+        return real_probe(*a, **kw)
+
+    est._auto_probe_eval_fuse = counting_probe
+    stats = est.fit({"x": x, "y": y}, epochs=3, batch_size=64,
+                    validation_data={"x": x, "y": y}, verbose=False)
+    assert all("val_mae" in s and np.isfinite(s["val_mae"]) for s in stats)
+    assert calls["n"] <= 1          # probed once, cached for epochs 2-3
