@@ -18,12 +18,16 @@ stage application in ``jax.checkpoint`` so the scan stores only
 stage INPUTS and recomputes internals during the backward — the GPipe
 paper's own configuration, bringing residuals to O(M) microbatch
 activations per rank. A true 1F1B schedule would cap that at O(pp)
-in-flight microbatches instead of O(M), at the cost of hand-scheduling
-the backward interleave outside ``jax.grad``; with remat on and the
-typical M ≈ 4·pp, the memory delta is ~4x on activations only (params/
-optimizer dominate at scale), so GPipe+remat is this framework's v1
-training schedule and the bubble/memory tradeoff is: bubble
-(pp-1)/(M+pp-1) shrinks with M while activation residuals grow with M.
+in-flight microbatches instead of O(M) — but under XLA's SPMD model it
+is a net loss here: every rank executes one traced program, so the
+per-tick "this rank does a forward OR a backward" choice lowers to
+predicated execution of BOTH branches; a hand-scheduled 1F1B scan
+(2(M+pp-1) ticks × predicated fwd+vjp per tick) costs ~1.5x the FLOPs
+of GPipe+remat to save ~(M/pp)x on activations alone, while params +
+optimizer state dominate memory at scale. GPipe+remat is therefore this
+framework's training schedule by design, not omission; the remaining
+tradeoff is: bubble (pp-1)/(M+pp-1) shrinks with M while activation
+residuals grow with M.
 
 Functional surface (flax-module-agnostic):
 
