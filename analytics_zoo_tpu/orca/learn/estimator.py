@@ -86,10 +86,11 @@ class TPUEstimator:
         self.train_stats: List[Dict[str, float]] = []
         self._tb_train = None
         self._tb_val = None
-        # probed eval fuse factor per input signature (fit with
-        # validation_data evaluates every epoch; the probe answer cannot
-        # change for the same model/shapes)
-        self._eval_fuse_cache: Dict = {}
+        # probed fuse factors per (mode, input signature): fit with
+        # validation_data evaluates every epoch, and hyperparameter loops
+        # re-fit — the probe answer cannot change for the same
+        # model/shapes, so pay it once
+        self._fuse_probe_cache: Dict = {}
 
     # --- gradient clipping (reference: orca/learn/tf/estimator.py
     # set_constant_gradient_clipping / set_l2_norm_gradient_clipping,
@@ -234,7 +235,16 @@ class TPUEstimator:
         elif it.steps_per_epoch < 2:
             return 1
         else:
-            k = self._auto_probe_fuse(it, batch_bytes)
+            # cache per input signature, like the eval probe: repeated
+            # fits on one estimator (hyperparameter loops, warm restarts)
+            # must not re-pay the probe's dispatches + state snapshot
+            key = ("train", it.local_bs) + tuple(
+                (np.asarray(a[:1]).shape[1:], str(np.asarray(a[:1]).dtype))
+                for a in tuple(it.x) + tuple(it.y or ()))
+            k = self._fuse_probe_cache.get(key)
+            if k is None:
+                k = self._auto_probe_fuse(it, batch_bytes)
+                self._fuse_probe_cache[key] = k
         return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch,
                                      trigger)
 
@@ -522,13 +532,13 @@ class TPUEstimator:
         if cfg != "auto":
             k = cfg
         else:
-            key = (it.local_bs,) + tuple(
+            key = ("eval", it.local_bs) + tuple(
                 (np.asarray(a[:1]).shape[1:], str(np.asarray(a[:1]).dtype))
                 for a in tuple(it.x) + tuple(it.y or ()))
-            k = self._eval_fuse_cache.get(key)
+            k = self._fuse_probe_cache.get(key)
             if k is None:
                 k = self._auto_probe_eval_fuse(it, sample, batch_bytes)
-                self._eval_fuse_cache[key] = k
+                self._fuse_probe_cache[key] = k
         return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch)
 
     def _auto_probe_eval_fuse(self, it, sample, batch_bytes: int) -> int:
