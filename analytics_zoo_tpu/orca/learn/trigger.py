@@ -125,6 +125,15 @@ class MinLoss(Trigger):
 
 
 class _Composite(Trigger):
+    """Shared arm/fuse_cap forwarding for TriggerAnd/TriggerOr.
+
+    Note on stateful children: SeveralIteration's bucket edge-detector
+    consumes its interval edge when ITS __call__ fires, even if the
+    composite as a whole evaluates false (e.g. TriggerAnd with a MinLoss
+    that is not yet met) — the composite then won't fire again until the
+    next interval boundary. This matches the reference's exact-step
+    semantics (both conditions must hold at the boundary check)."""
+
     def __init__(self, first: Trigger, *others: Trigger):
         self.triggers = (first,) + others
 
