@@ -51,6 +51,9 @@ class DiskFeatureSet:
         self.mesh = mesh
         self.seed = seed
         self._owns_dir = _owns_dir
+        from ..native.infeed import PipelineStats
+        self.stats = PipelineStats()    # shared with the estimator's
+        # data_pipeline_stats() when fed through data_to_iterator
         meta = np.load(os.path.join(cache_dir, "meta.npy"),
                        allow_pickle=True).item()
         self.n: int = meta["n"]
@@ -183,7 +186,8 @@ class DiskFeatureSet:
             return
         from ..native.infeed import InfeedPump
         yield from InfeedPump(lambda: self._host_batches(shuffle),
-                              device_put=self._put_batch, depth=2)
+                              device_put=self._put_batch, depth=2,
+                              stats=self.stats)
 
     def cleanup(self):
         if self._owns_dir:

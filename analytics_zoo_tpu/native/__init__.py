@@ -1,3 +1,3 @@
-from .infeed import InfeedPump
+from .infeed import InfeedPump, PipelineStats
 from .runtime import (Arena, NativeQueue, available, f32_to_bf16_bits,
                       gather_rows, pad_sequences, shuffled_indices, version)

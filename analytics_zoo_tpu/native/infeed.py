@@ -1,71 +1,311 @@
-"""Device infeed pump: double-buffered host→HBM pipeline.
+"""Device infeed pump: pipelined, instrumented host→HBM data plane.
 
 The reference hides infeed latency with per-executor JVM threads pulling from
-Spark block manager (SURVEY.md §3.2); on TPU the equivalent is: a background
-host thread assembles the next batch (native gather/pad, no GIL) and calls
-``jax.device_put`` while the current step runs, so the chip never waits on the
-host (SURVEY.md §7 hard part #1)."""
+Spark block manager (SURVEY.md §3.2); on TPU the equivalent is a three-stage
+pipeline that keeps the chip fed while the host assembles:
+
+  assembly workers (N threads)  →  one in-order H2D stage  →  consumer
+  gather/pad per batch, no GIL     jax.device_put, ordered     train loop
+
+A factory may yield either ready host batches (legacy contract, used by the
+streaming pipelines) or **zero-arg assembly tasks** (callables); tasks are
+fanned out over N workers and re-ordered before the single H2D stage, so
+slow batch assembly no longer serializes behind the transfer. The delivery
+queue's depth is adaptive: it grows while the consumer is observed starving
+(bounded by a host-memory budget), so a bursty producer gets buffer and a
+steady one stays at the configured depth.
+
+Every stage reports into a :class:`PipelineStats` — the counters surfaced
+by ``estimator.data_pipeline_stats()`` and printed by ``bench.py`` — so
+perf work can see where epoch time goes (assemble / H2D / step / stall).
+"""
 
 from __future__ import annotations
 
+import os
 import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, Optional
 
 import jax
 
-from .runtime import NativeQueue
-
 _STOP = object()
+_DONE = object()
+
+# staging-memory budget for the adaptive prefetch depth: depth is never
+# grown past budget / batch_bytes, so staged batches stay O(batch × depth).
+# NOTE the delivery queue holds post-device_put batches — every staged
+# batch is HBM-resident, so this budget bounds device memory as much as
+# host memory; the defaults are deliberately conservative (256 MB, depth
+# cap 8) so adaptive growth cannot OOM a model that fit at depth 2.
+_DEFAULT_BUDGET_MB = 256
+_MAX_DEPTH = 8
+
+
+class PipelineStats:
+    """Monotonic per-stage timers/counters for the input pipeline.
+
+    Stages: ``assemble`` (host batch gather/pad), ``h2d`` (device_put),
+    ``step`` (engine dispatch, recorded by TrainEngine), ``stall`` (time
+    the consumer waited on the delivery queue). Thread-safe; shared by the
+    iterator, the pump, and the engine.
+    """
+
+    STAGES = ("assemble", "h2d", "step", "stall")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._time = {s: 0.0 for s in self.STAGES}
+            self._count = {s: 0 for s in self.STAGES}
+            self.h2d_bytes = 0
+            self.depth = 0
+            self.depth_peak = 0
+            self.depth_growths = 0
+
+    def add(self, stage: str, seconds: float, count: int = 1,
+            nbytes: int = 0):
+        with self._lock:
+            self._time[stage] += seconds
+            self._count[stage] += count
+            if nbytes:
+                self.h2d_bytes += nbytes
+
+    def observe_depth(self, depth: int, grew: bool = False):
+        with self._lock:
+            self.depth = depth
+            self.depth_peak = max(self.depth_peak, depth)
+            if grew:
+                self.depth_growths += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for s in self.STAGES:
+                out[f"{s}_s"] = round(self._time[s], 6)
+                out[f"{s}_n"] = self._count[s]
+            out["h2d_bytes"] = self.h2d_bytes
+            out["h2d_MBps"] = (
+                round(self.h2d_bytes / self._time["h2d"] / 1e6, 1)
+                if self._time["h2d"] > 0 else 0.0)
+            out["depth"] = self.depth
+            out["depth_peak"] = self.depth_peak
+            out["depth_growths"] = self.depth_growths
+            return out
+
+
+def _batch_nbytes(b) -> int:
+    """Host/device bytes of a batch-like object (Batch dataclass duck-typed
+    via x/y/w, plain array, or tuple of arrays)."""
+    if hasattr(b, "x"):
+        leaves = list(b.x) + list(b.y or ()) + (
+            [b.w] if getattr(b, "w", None) is not None else [])
+    elif isinstance(b, (list, tuple)):
+        leaves = list(b)
+    else:
+        leaves = [b]
+    return sum(int(getattr(a, "nbytes", 0)) for a in leaves)
+
+
+class _FlexQueue:
+    """Bounded FIFO with adjustable capacity and close(); in-order by
+    construction (single producer). Pure Python: the payloads' heavy work
+    (numpy gathers, device_put) releases the GIL, so a Condition-based
+    queue is not on the critical path."""
+
+    def __init__(self, capacity: int):
+        self._cv = threading.Condition()
+        self._items: deque = deque()
+        self.capacity = max(1, capacity)
+        self._closed = False
+
+    def put(self, item) -> bool:
+        with self._cv:
+            while len(self._items) >= self.capacity and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._cv.notify_all()
+            return True
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cv:
+            deadline = None if timeout is None else (
+                time.monotonic() + timeout)
+            while not self._items and not self._closed:
+                remaining = None if deadline is None else (
+                    deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            if self._items:
+                item = self._items.popleft()
+                self._cv.notify_all()
+                return item
+            return None                 # closed and drained
+
+    def grow(self, capacity: int):
+        with self._cv:
+            if capacity > self.capacity:
+                self.capacity = capacity
+                self._cv.notify_all()
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+def _default_workers() -> int:
+    env = os.environ.get("ZOO_INFEED_WORKERS")
+    if env:
+        return max(1, int(env))
+    return min(4, os.cpu_count() or 2)
 
 
 class InfeedPump:
-    """Wrap a host-batch iterator factory; yields device-resident batches one
-    step ahead of consumption."""
+    """Wrap a host-batch (or assembly-task) iterator factory; yields
+    device-resident batches ahead of consumption.
+
+    Parameters
+    ----------
+    batch_iter_factory : returns an iterator of host batches OR of zero-arg
+        callables that assemble one (tasks get fanned out over ``workers``
+        assembly threads and re-ordered).
+    device_put : staging function applied in-order by the single H2D stage.
+    depth : initial delivery-queue depth.
+    max_depth : hard depth ceiling; default derives from the staging
+        budget (``ZOO_INFEED_BUDGET_MB``, 256 MB — bounds HBM as well as
+        host bytes, staged batches live on device) and the first batch
+        size, capped at 8.
+    workers : assembly thread count (``ZOO_INFEED_WORKERS``, default
+        min(4, cpus)); only used for task-yielding factories.
+    stats : shared :class:`PipelineStats`; a private one is created if
+        omitted (exposed as ``pump.stats``).
+    """
 
     def __init__(self, batch_iter_factory: Callable[[], Iterator],
-                 device_put: Optional[Callable] = None, depth: int = 2):
+                 device_put: Optional[Callable] = None, depth: int = 2,
+                 max_depth: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 stats: Optional[PipelineStats] = None,
+                 host_mem_budget: Optional[int] = None):
         self._factory = batch_iter_factory
         self._device_put = device_put or jax.device_put
-        self._depth = depth
+        self._depth = max(1, depth)
+        self._max_depth = max_depth
+        self._workers = workers if workers is not None else _default_workers()
+        self.stats = stats if stats is not None else PipelineStats()
+        self._budget = host_mem_budget if host_mem_budget is not None else (
+            int(os.environ.get("ZOO_INFEED_BUDGET_MB",
+                               str(_DEFAULT_BUDGET_MB))) << 20)
+
+    # --- producer side -------------------------------------------------------
+    def _assemble(self, task):
+        t0 = time.perf_counter()
+        batch = task()
+        self.stats.add("assemble", time.perf_counter() - t0)
+        return batch
+
+    def _stage_h2d(self, q: _FlexQueue, host_batch) -> bool:
+        t0 = time.perf_counter()
+        dev = self._device_put(host_batch)
+        self.stats.add("h2d", time.perf_counter() - t0,
+                       nbytes=_batch_nbytes(host_batch))
+        return q.put(dev)
+
+    def _producer(self, q: _FlexQueue, err: list):
+        pool = None
+        window: deque = deque()     # in-flight assembly futures, in order
+        try:
+            src = iter(self._factory())
+            while True:
+                t0 = time.perf_counter()
+                item = next(src, _DONE)
+                dt = time.perf_counter() - t0
+                if item is _DONE:
+                    break
+                if callable(item):
+                    # assembly task: fan out, keep order via the window
+                    if pool is None:
+                        pool = ThreadPoolExecutor(
+                            self._workers,
+                            thread_name_prefix="zoo-infeed-asm")
+                    window.append(pool.submit(self._assemble, item))
+                    # H2D the oldest once the window covers the workers —
+                    # its gather is done or about to be; later tasks keep
+                    # assembling meanwhile
+                    if len(window) > self._workers:
+                        if not self._stage_h2d(q, window.popleft().result()):
+                            return
+                else:
+                    # legacy contract: the iterator assembled the batch in
+                    # next(); that time IS the assemble stage
+                    self.stats.add("assemble", dt)
+                    if not self._stage_h2d(q, item):
+                        return
+            while window:
+                if not self._stage_h2d(q, window.popleft().result()):
+                    return
+        except Exception as e:          # surface on the consumer side
+            err.append(e)
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
+            # Blocking put: the sentinel must never be dropped, or the
+            # consumer hangs forever at epoch end. If the queue is full
+            # (consumer stuck in a long first-step jit compile) this waits
+            # for a slot; the consumer's finally q.close() unblocks the
+            # wait when iteration is abandoned.
+            q.put(_STOP)
+
+    # --- consumer side -------------------------------------------------------
+    def _maybe_grow(self, q: _FlexQueue, sample_batch):
+        if self._max_depth is None:
+            bb = _batch_nbytes(sample_batch)
+            self._max_depth = max(
+                self._depth, min(_MAX_DEPTH, self._budget // max(bb, 1)))
+        if q.capacity < self._max_depth:
+            q.grow(min(q.capacity * 2, self._max_depth))
+            self.stats.observe_depth(q.capacity, grew=True)
 
     def __iter__(self):
-        q = NativeQueue(capacity=self._depth)
-        err = []
-
-        def producer():
-            try:
-                for batch in self._factory():
-                    if not q.put(self._device_put(batch)):
-                        return          # consumer closed the queue: stop
-            except Exception as e:          # surface on the consumer side
-                err.append(e)
-            finally:
-                # Blocking put: the sentinel must never be dropped, or the
-                # consumer hangs forever in q.get() at epoch end. If the
-                # queue is full (consumer stuck in a long first-step jit
-                # compile) this waits for a slot; the consumer's finally
-                # q.close() unblocks the wait when iteration is abandoned.
-                q.put(_STOP, timeout_ms=-1)
-
-        t = threading.Thread(target=producer, daemon=True,
-                             name="zoo-infeed-pump")
+        q = _FlexQueue(self._depth)
+        self.stats.observe_depth(q.capacity)
+        err: list = []
+        t = threading.Thread(target=self._producer, args=(q, err),
+                             daemon=True, name="zoo-infeed-pump")
         t.start()
+        first = True
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                wait = time.perf_counter() - t0
                 if item is _STOP or item is None:
                     break
+                # the first get always waits on pipeline warmup — not a
+                # steady-state starvation signal
+                if not first:
+                    self.stats.add("stall", wait)
+                    if wait > 1e-4 and t.is_alive():
+                        # consumer starved while the producer still runs:
+                        # deepen the buffer (bounded by the memory budget)
+                        self._maybe_grow(q, item)
+                first = False
                 yield item
         finally:
             q.close()                   # unblocks the producer's put()
             t.join(timeout=30)
             if t.is_alive():
-                # never free the native queue under a live producer; leaking
-                # one queue beats a use-after-free
                 import logging
                 logging.getLogger("analytics_zoo_tpu").warning(
-                    "infeed producer did not stop; leaking its queue")
-            else:
-                q.destroy()
+                    "infeed producer did not stop; abandoning its thread")
         if err:
             raise err[0]

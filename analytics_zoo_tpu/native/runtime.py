@@ -235,14 +235,31 @@ def shuffled_indices(n: int, seed: int = 0) -> np.ndarray:
 
 
 def gather_rows(src: np.ndarray, idx: np.ndarray,
-                num_threads: int = 4) -> np.ndarray:
-    """out[i] = src[idx[i]] — threaded memcpy batch assembly."""
+                num_threads: int = 4,
+                out: Optional[np.ndarray] = None) -> np.ndarray:
+    """out[i] = src[idx[i]] — threaded memcpy batch assembly.
+
+    ``out`` lets callers gather straight into a preallocated destination
+    (e.g. a contiguous slice of a larger staging buffer) instead of paying
+    a fresh allocation per batch; it must be C-contiguous with the gather's
+    shape and dtype."""
     lib = load()
     src = np.ascontiguousarray(src)
     idx = np.ascontiguousarray(idx, np.int64)
+    shape = (len(idx),) + src.shape[1:]
+    if out is not None:
+        if (out.shape != shape or out.dtype != src.dtype
+                or not out.flags.c_contiguous):
+            raise ValueError(
+                f"out must be C-contiguous {shape} {src.dtype}, got "
+                f"{out.shape} {out.dtype}")
     if lib is None:
-        return src[idx]
-    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+        if out is None:
+            return src[idx]
+        np.take(src, idx, axis=0, out=out)
+        return out
+    if out is None:
+        out = np.empty(shape, src.dtype)
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], initial=1))
     lib.za_gather_rows(
         src.ctypes.data_as(ctypes.c_char_p), row_bytes,

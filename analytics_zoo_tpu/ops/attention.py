@@ -231,18 +231,37 @@ def _mosaic_params():
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct that declares shard_map varying axes where the
+    installed jax supports the ``vma`` kwarg (no-op arg otherwise — older
+    jax has no vma typing to satisfy)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _vma_of(a):
+    """Varying-axes set of one array; empty on jax builds without vma
+    typing (no jax.typeof)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(a), "vma", None) or frozenset()
+
+
 def _input_vma(arrays):
     """Union of the operands' shard_map varying sets (see _flash_forward)."""
     vma = frozenset()
     for a in arrays:
-        vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+        vma = vma | _vma_of(a)
     return vma
 
 
 def _lift_vma(arrays, vma):
-    return [jax.lax.pvary(
-        a, tuple(vma - (getattr(jax.typeof(a), "vma", None) or frozenset())))
-        for a in arrays]
+    if not hasattr(jax.lax, "pvary"):
+        return list(arrays)
+    return [jax.lax.pvary(a, tuple(vma - _vma_of(a))) for a in arrays]
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
@@ -281,12 +300,12 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     vma = _input_vma((qf, kf, vf))
     if vma:
         qf, kf, vf = _lift_vma((qf, kf, vf), vma)
-    out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype, vma=vma)]
+    out_shape = [_sds((b * h, s_q, d), q.dtype, vma)]
     out_specs = [pl.BlockSpec((1, block_q, d),
                               lambda bh, qi, ki: (bh, qi, 0))]
     if with_lse:
         out_shape.append(
-            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma))
+            _sds((b * h, s_q, 1), jnp.float32, vma))
         out_specs.append(pl.BlockSpec((1, block_q, 1),
                                       lambda bh, qi, ki: (bh, qi, 0)))
     if causal:
@@ -527,7 +546,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
             pl.BlockSpec((1, bq, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype, vma=vma),
+        out_shape=_sds((bh, s_q, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=None if interpret else _mosaic_params(),
         interpret=interpret,
@@ -553,8 +572,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
             pl.BlockSpec((1, bk, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype, vma=vma),
+            _sds((bh, s_k, d), k.dtype, vma),
+            _sds((bh, s_k, d), v.dtype, vma),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
@@ -605,9 +624,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     block_q, block_k = bq, bk
     if not _on_tpu():
-        vma = frozenset()
-        for a in (q, k, v):
-            vma = vma | (getattr(jax.typeof(a), "vma", None) or frozenset())
+        vma = _input_vma((q, k, v))
         if vma:
             # Interpret-mode pallas under shard_map is unreliable in jax
             # 0.9: the HLO interpreter's grid dynamic_slice rejects

@@ -73,6 +73,9 @@ class ImageNetPipeline:
         self.train = train
         self.seed = seed
         self.num_workers = num_workers
+        from analytics_zoo_tpu.native.infeed import PipelineStats
+        self.stats = PipelineStats()    # shared with the estimator's
+        # data_pipeline_stats() when fed through data_to_iterator
         names = sorted(f for f in os.listdir(data_dir)
                        if f.endswith("-images.npy"))
         if not names:
@@ -183,4 +186,5 @@ class ImageNetPipeline:
             return
         from analytics_zoo_tpu.native.infeed import InfeedPump
         yield from InfeedPump(lambda: self._host_batches(shuffle),
-                              device_put=self._put_batch, depth=2)
+                              device_put=self._put_batch, depth=2,
+                              stats=self.stats)

@@ -101,23 +101,81 @@ class XShards:
 class HostXShards(XShards):
     """Host-local shard collection: a list of partitions, each one element
     (numpy dict, pandas DataFrame, or arbitrary object) — the TPU-native
-    stand-in for both SparkXShards and RayXShards."""
+    stand-in for both SparkXShards and RayXShards.
+
+    ``transform_shard`` is **lazy with stage fusion**: a chain of k
+    transforms defers until the data is first read (collect / repartition /
+    len / ...), then runs as ONE pool pass per partition — the composed
+    stages execute back-to-back on each partition (one pool dispatch and
+    one pass of cache traffic instead of k). Every stage still runs
+    **exactly once** per partition: each node in the chain memoizes its
+    result during the fused pass, so reading an intermediate shards object
+    later never re-applies earlier stages (in-place transform functions
+    behave exactly as under the old eager implementation).
+    """
 
     def __init__(self, partitions: Sequence[Any], transient: bool = False):
-        self._parts: List[Any] = list(partitions)
+        self._parent: Optional["HostXShards"] = None
+        self._stage: Optional[tuple] = None
+        self._materialized: Optional[List[Any]] = list(partitions)
         self.transient = transient
+
+    @classmethod
+    def _lazy(cls, parent: "HostXShards", stage: tuple,
+              transient: bool = False) -> "HostXShards":
+        out = cls.__new__(cls)
+        out._parent = parent
+        out._stage = stage
+        out._materialized = None
+        out.transient = transient
+        return out
+
+    @property
+    def _parts(self) -> List[Any]:
+        """Materialized partitions. Walks up to the nearest already-
+        materialized ancestor, then runs the pending stages as ONE fused
+        pool pass per partition, memoizing every node on the way so each
+        stage executes exactly once no matter which nodes are read later."""
+        if self._materialized is not None:
+            return self._materialized
+        chain: List["HostXShards"] = []
+        node = self
+        while node._materialized is None:
+            chain.append(node)
+            node = node._parent
+        base = node._materialized
+        chain.reverse()
+        stages = [n._stage for n in chain]
+
+        def run(p):
+            outs = []
+            for fn, args in stages:
+                p = fn(p, *args)
+                outs.append(p)
+            return outs
+
+        results = _pmap(run, base)
+        for i, n in enumerate(chain):
+            n._materialized = [r[i] for r in results]
+        return self._materialized
 
     # --- core ---------------------------------------------------------------
     def transform_shard(self, func: Callable, *args) -> "HostXShards":
-        """Apply ``func(shard, *args)`` to every partition in parallel
-        (reference: shard.py:146-163)."""
-        return HostXShards(_pmap(lambda p: func(p, *args), self._parts))
+        """Apply ``func(shard, *args)`` to every partition (reference:
+        shard.py:146-163). Lazy: the call is recorded and fused with any
+        further ``transform_shard`` calls into one pool pass per partition,
+        executed (exactly once per stage) on first read."""
+        return HostXShards._lazy(self, (func, args))
 
     def collect(self) -> List[Any]:
         return list(self._parts)
 
     def num_partitions(self) -> int:
-        return len(self._parts)
+        # transforms are 1:1 per partition — no need to materialize
+        node = self
+        while node._materialized is None:
+            node = node._parent
+        return len(node._materialized)
 
     def cache(self) -> "HostXShards":
         self.transient = False
@@ -134,17 +192,65 @@ class HostXShards(XShards):
         return self
 
     # --- reshaping ----------------------------------------------------------
+    @staticmethod
+    def _split_bounds(total: int, n: int) -> List[tuple]:
+        """[start, stop) ranges identical to ``np.array_split(arange(total),
+        n)`` — the reference's even re-split, expressed as chunk indices."""
+        base, extra = divmod(total, n)
+        bounds, start = [], 0
+        for i in range(n):
+            stop = start + base + (1 if i < extra else 0)
+            bounds.append((start, stop))
+            start = stop
+        return bounds
+
     def repartition(self, num_partitions: int) -> "HostXShards":
-        """Coalesce/split partitions. For dict-of-ndarray or DataFrame shards
-        the rows are concatenated then re-split evenly (reference merges rows
-        the same way, shard.py:219-293)."""
+        """Coalesce/split partitions into even contiguous row ranges (same
+        row sets as the reference's merge-then-split, shard.py:219-293) —
+        but computed on chunk indices: no merged full-dataset copy is ever
+        built. Each output partition is its own copy (one copy of each row
+        total, vs the old merge+split's two), so mutating an output never
+        writes through to the source shards."""
+        from .chunked import ChunkedArray
         parts = self._parts
         if not parts:
             return HostXShards([])
         first = parts[0]
+        if isinstance(first, dict) and all(
+                isinstance(v, np.ndarray) or
+                (isinstance(v, tuple) and
+                 all(isinstance(a, np.ndarray) for a in v))
+                for v in first.values()):
+            cols = {}
+            for k, v in first.items():
+                if isinstance(v, tuple):
+                    cols[k] = tuple(ChunkedArray([p[k][i] for p in parts])
+                                    for i in range(len(v)))
+                else:
+                    cols[k] = ChunkedArray([p[k] for p in parts])
+            lead = next(iter(cols.values()))
+            total = len(lead[0] if isinstance(lead, tuple) else lead)
+
+            def cut(c: ChunkedArray, start: int, stop: int) -> np.ndarray:
+                piece = c.slice(start, stop)
+                # in-chunk slices come back as views — copy at this API
+                # boundary so partitions never alias the inputs (seam
+                # slices are already fresh concatenations)
+                return piece.copy() if piece.base is not None else piece
+
+            out = []
+            for start, stop in self._split_bounds(total, num_partitions):
+                out.append({
+                    k: (tuple(cut(c, start, stop) for c in v)
+                        if isinstance(v, tuple) else cut(v, start, stop))
+                    for k, v in cols.items()})
+            return HostXShards(out)
         if isinstance(first, dict):
+            # dict shards with non-array leaves (lists, scalars): coerce and
+            # merge like the reference did
             merged = {
-                k: np.concatenate([p[k] for p in parts]) for k in first}
+                k: np.concatenate([np.asarray(p[k]) for p in parts])
+                for k in first}
             total = len(nest.flatten(merged)[0])
             splits = np.array_split(np.arange(total), num_partitions)
             return HostXShards([
@@ -152,12 +258,25 @@ class HostXShards(XShards):
         try:
             import pandas as pd
             if isinstance(first, pd.DataFrame):
-                merged_df = pd.concat(parts, ignore_index=True)
-                splits = np.array_split(np.arange(len(merged_df)),
-                                        num_partitions)
-                return HostXShards([
-                    merged_df.iloc[idx].reset_index(drop=True)
-                    for idx in splits])
+                sizes = [len(p) for p in parts]
+                offs = np.zeros(len(sizes) + 1, np.int64)
+                np.cumsum(sizes, out=offs[1:])
+                out = []
+                for start, stop in self._split_bounds(
+                        int(offs[-1]), num_partitions):
+                    pieces = []
+                    for i, p in enumerate(parts):
+                        lo = max(start - int(offs[i]), 0)
+                        hi = min(stop - int(offs[i]), sizes[i])
+                        if hi > lo:
+                            pieces.append(p.iloc[lo:hi])
+                    if not pieces:
+                        out.append(first.iloc[0:0].reset_index(drop=True))
+                    elif len(pieces) == 1:
+                        out.append(pieces[0].reset_index(drop=True))
+                    else:
+                        out.append(pd.concat(pieces, ignore_index=True))
+                return HostXShards(out)
         except ImportError:
             pass
         if isinstance(first, (list, np.ndarray)):
@@ -173,24 +292,31 @@ class HostXShards(XShards):
     def partition_by(self, cols, num_partitions: Optional[int] = None
                      ) -> "HostXShards":
         """Hash-partition pandas-DataFrame shards by column values
-        (reference: shard.py:295-340)."""
+        (reference: shard.py:295-340). Hashes and filters per input shard
+        (row hashes are position-independent), so no merged full copy is
+        built; output rows appear in the same order as the reference's
+        merge-then-mask."""
         import pandas as pd
         dfs = [p for p in self._parts if isinstance(p, pd.DataFrame)]
         if len(dfs) != len(self._parts):
             raise ValueError("partition_by requires pandas DataFrame shards")
         if isinstance(cols, str):
             cols = [cols]
-        merged = pd.concat(dfs, ignore_index=True)
         n = num_partitions or self.num_partitions()
-        keys = pd.util.hash_pandas_object(merged[cols], index=False).to_numpy()
-        assignment = keys % n
-        return HostXShards([
-            merged[assignment == i].reset_index(drop=True) for i in range(n)])
+        assignments = _pmap(
+            lambda df: pd.util.hash_pandas_object(
+                df[cols], index=False).to_numpy() % n, dfs)
+        out = []
+        for i in range(n):
+            pieces = [df[a == i] for df, a in zip(dfs, assignments)]
+            out.append(pd.concat(pieces, ignore_index=True))
+        return HostXShards(out)
 
     def unique(self) -> np.ndarray:
         """Distinct elements across all partitions (reference: shard.py:341;
-        shards must be 1-D arrays/Series)."""
-        vals = [np.asarray(p) for p in self._parts]
+        shards must be 1-D arrays/Series). Deduplicates per partition first
+        so the cross-partition merge is over distinct values, not rows."""
+        vals = _pmap(lambda p: np.unique(np.asarray(p)), self._parts)
         return np.unique(np.concatenate(vals))
 
     def split(self) -> List["HostXShards"]:
@@ -239,12 +365,11 @@ class HostXShards(XShards):
 
     def __getitem__(self, key: str) -> "HostXShards":
         """Column/key selection on dict or DataFrame shards
-        (reference: shard.py:432-442)."""
+        (reference: shard.py:432-442). Lazy like transform_shard — fused
+        with any downstream transforms."""
         def get_data(p):
-            if isinstance(p, dict):
-                return p[key]
-            return p[key]  # pandas column
-        return HostXShards(_pmap(get_data, self._parts), transient=True)
+            return p[key]  # dict key or pandas column
+        return HostXShards._lazy(self, (get_data, ()), transient=True)
 
     def _get_class_name(self) -> str:
         return type(self._parts[0]).__name__ if self._parts else "empty"

@@ -18,6 +18,7 @@ arXiv:2004.13336).
 from __future__ import annotations
 
 import inspect
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -89,6 +90,12 @@ class TrainEngine:
         self._clip_norm: Optional[float] = None
         self._clip_min: Optional[float] = None
         self._clip_max: Optional[float] = None
+        # optional PipelineStats (set by the estimator): the engine records
+        # its dispatch time under the "step" stage so the data-plane timers
+        # (assemble/h2d/stall) have a compute-side denominator. Host-side
+        # dispatch time, deliberately: blocking on the result every step
+        # would serialize async dispatch.
+        self.pipeline_stats = None
 
     # --- gradient clipping (reference plumbs clip-by-L2 / clip-constant
     # through every estimator: zoo/.../pipeline/estimator/Estimator.scala:
@@ -356,9 +363,14 @@ class TrainEngine:
         if self._jit_eval_multi is None:
             self._jit_eval_multi = jax.jit(self._eval_multi_step,
                                            donate_argnums=(2,))
-        return self._jit_eval_multi(self.params, self.extra_vars,
-                                    metric_states, batch.x, batch.y,
-                                    batch.w)
+        t0 = time.perf_counter()
+        out = self._jit_eval_multi(self.params, self.extra_vars,
+                                   metric_states, batch.x, batch.y,
+                                   batch.w)
+        if self.pipeline_stats is not None:
+            self.pipeline_stats.add("step", time.perf_counter() - t0,
+                                    count=int(batch.fused))
+        return out
 
     def _predict_step(self, params, extra, x):
         preds, _ = self._apply(params, extra, x, False)
@@ -375,9 +387,12 @@ class TrainEngine:
 
     def train_batch(self, batch: Batch) -> jnp.ndarray:
         self.ensure_jit_train()
+        t0 = time.perf_counter()
         self.params, self.extra_vars, self.opt_state, loss = self._jit_train(
             self.params, self.extra_vars, self.opt_state,
             jnp.asarray(self.step), batch.x, batch.y, batch.w)
+        if self.pipeline_stats is not None:
+            self.pipeline_stats.add("step", time.perf_counter() - t0)
         self.step += 1
         return loss
 
@@ -388,11 +403,16 @@ class TrainEngine:
         if self._jit_train_multi is None:
             self._jit_train_multi = jax.jit(self._train_multi_step,
                                             donate_argnums=(0, 2))
+        t0 = time.perf_counter()
         self.params, self.extra_vars, self.opt_state, losses = \
             self._jit_train_multi(
                 self.params, self.extra_vars, self.opt_state,
                 jnp.asarray(self.step), batch.x, batch.y, batch.w)
-        self.step += int(losses.shape[0])
+        k = int(losses.shape[0])
+        if self.pipeline_stats is not None:
+            self.pipeline_stats.add("step", time.perf_counter() - t0,
+                                    count=k)
+        self.step += k
         return losses
 
     def init_metric_states(self):
@@ -406,8 +426,12 @@ class TrainEngine:
             # metric states are consumed and replaced every batch — donate
             # them so XLA updates in place instead of reallocating
             self._jit_eval = jax.jit(self._eval_step, donate_argnums=(2,))
-        return self._jit_eval(self.params, self.extra_vars, metric_states,
-                              batch.x, batch.y, batch.w)
+        t0 = time.perf_counter()
+        out = self._jit_eval(self.params, self.extra_vars, metric_states,
+                             batch.x, batch.y, batch.w)
+        if self.pipeline_stats is not None:
+            self.pipeline_stats.add("step", time.perf_counter() - t0)
+        return out
 
     def finalize_metrics(self, metric_states, loss_sum, count) -> Dict[str, float]:
         out = {}

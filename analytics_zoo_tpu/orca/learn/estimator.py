@@ -82,6 +82,11 @@ class TPUEstimator:
         tx = convert_optimizer(optimizer)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
                                   self.mesh, seed=seed, fsdp_params=fsdp)
+        # one stats object spans iterator assembly, the pump's H2D stage and
+        # the engine's dispatches — the estimator is where they all meet
+        from ...native.infeed import PipelineStats
+        self._pipeline_stats = PipelineStats()
+        self.engine.pipeline_stats = self._pipeline_stats
         self._trainer_state = TrainerState()
         self.train_stats: List[Dict[str, float]] = []
         self._tb_train = None
@@ -91,6 +96,19 @@ class TPUEstimator:
         # re-fit — the probe answer cannot change for the same
         # model/shapes, so pay it once
         self._fuse_probe_cache: Dict = {}
+
+    # --- pipeline observability ---------------------------------------------
+    def data_pipeline_stats(self, reset: bool = False) -> Dict[str, Any]:
+        """Cumulative input-pipeline stage counters: ``assemble_s`` (host
+        batch gather), ``h2d_s`` (+``h2d_bytes``/``h2d_MBps``, device
+        staging), ``step_s`` (engine dispatch), ``stall_s`` (training loop
+        starved waiting on the infeed), plus the pump's prefetch ``depth``
+        history. Every future perf PR should look here first to see where
+        epoch time goes."""
+        snap = self._pipeline_stats.snapshot()
+        if reset:
+            self._pipeline_stats.reset()
+        return snap
 
     # --- gradient clipping (reference: orca/learn/tf/estimator.py
     # set_constant_gradient_clipping / set_l2_norm_gradient_clipping,
@@ -160,7 +178,8 @@ class TPUEstimator:
         in InternalDistriOptimizer (Topology.scala:1256-1337)."""
         it = learn_utils.data_to_iterator(
             data, batch_size, self.mesh, feature_cols, label_cols,
-            shuffle=shuffle, config=self.config)
+            shuffle=shuffle, config=self.config,
+            stats=self._pipeline_stats)
         sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         checkpoint_trigger = (Trigger.convert_trigger(checkpoint_trigger)
@@ -488,7 +507,8 @@ class TPUEstimator:
         """(reference surface: orca/learn/tf2/estimator.py:264-347)"""
         it = learn_utils.data_to_iterator(
             data, batch_size, self.mesh, feature_cols, label_cols,
-            shuffle=False, config=self.config)
+            shuffle=False, config=self.config,
+            stats=self._pipeline_stats)
         sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         fuse = self._choose_eval_fuse(it, sample, num_steps)
@@ -574,10 +594,11 @@ class TPUEstimator:
         array input."""
         is_shards = isinstance(data, HostXShards)
         shards = learn_utils.xshards_from_arrays(data, feature_cols, None)
-        merged = learn_utils.concat_shards(shards)
-        it = learn_utils.BatchIterator(merged, batch_size, self.mesh,
-                                       pad_tail=True)
-        self.engine.build(tuple(np.asarray(a[:1]) for a in merged["x"]))
+        chunked = learn_utils.chunk_shards(shards)
+        it = learn_utils.BatchIterator(chunked, batch_size, self.mesh,
+                                       pad_tail=True,
+                                       stats=self._pipeline_stats)
+        self.engine.build(tuple(np.asarray(a[:1]) for a in chunked["x"]))
         # dispatch ahead, fetch in CHUNKS: per-batch device_get would
         # serialize each dispatch behind a host round trip, but holding
         # every batch's outputs on device until one final fetch would make

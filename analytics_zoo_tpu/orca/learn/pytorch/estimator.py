@@ -145,8 +145,9 @@ class PyTorchTPUEstimator(TPUEstimator):
         if self.engine.params is None and self._param_loader is not None:
             from .. import utils as learn_utils
             shards = learn_utils.xshards_from_arrays(data)
-            merged = learn_utils.concat_shards(shards)
-            self.engine.build(tuple(np.asarray(a[:1]) for a in merged["x"]))
+            # chunked: only the first rows are ever touched, no merged copy
+            chunked = learn_utils.chunk_shards(shards)
+            self.engine.build(tuple(np.asarray(a[:1]) for a in chunked["x"]))
             self._load_torch_weights()
         return super().predict(data, batch_size=batch_size, **kwargs)
 

@@ -84,9 +84,10 @@ class TF2TPUEstimator(TPUEstimator):
             sample = next(it.epoch(shuffle=False, prefetch=False))
             self.engine.build(tuple(np.asarray(a) for a in sample.x))
         else:
-            merged = learn_utils.concat_shards(shards)
+            # chunked: only the first rows are ever touched, no merged copy
+            chunked = learn_utils.chunk_shards(shards)
             self.engine.build(tuple(np.asarray(a[:1])
-                                    for a in merged["x"]))
+                                    for a in chunked["x"]))
         self._load_keras_weights()
 
     def _load_keras_weights(self):

@@ -1,0 +1,77 @@
+"""Version-tolerant jax surface for the parallelism layer.
+
+jax >= 0.8 exports ``jax.shard_map`` (with the ``check_vma`` kwarg and vma
+typing via ``jax.typeof``/``lax.pvary``); older releases ship
+``jax.experimental.shard_map.shard_map`` with ``check_rep`` and no vma
+machinery. The helpers here paper over both so the ep/pp/sp code paths
+import and run on either generation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+try:                                        # jax >= 0.8 top-level export
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                         # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` facade: maps ``check_vma`` onto whichever kwarg the
+    installed jax understands. Usable as a decorator factory like the real
+    thing (``shard_map(mesh=..., in_specs=..., out_specs=...)(f)``)."""
+    if f is None:
+        return partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check_vma)
+    kw = {}
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    elif _CHECK_KW == "check_rep":
+        # old-jax replication checking has no rule for pallas_call (and
+        # several other primitives these code paths use); new jax handles
+        # them through vma typing. Default it off for parity.
+        kw[_CHECK_KW] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def axis_size(axis_name) -> int:
+    """Size of a mapped mesh axis from inside shard_map. ``lax.axis_size``
+    where it exists; on older jax the constant-folded ``psum(1, axis)``
+    (returns a Python int, no collective is emitted)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def varying_axes(*arrays) -> tuple:
+    """Union of the arrays' shard_map varying-axes sets; empty on jax
+    builds without vma typing. (ops/attention.py keeps local equivalents
+    — _vma_of/_input_vma — to avoid importing the parallel package from
+    the ops layer; keep the None-guard below in sync with them.)"""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return ()
+    out = frozenset()
+    for a in arrays:
+        # some builds expose .vma = None rather than omitting it
+        out |= getattr(typeof(a), "vma", None) or frozenset()
+    return tuple(out)
+
+
+def mark_varying(x, vma: tuple):
+    """Tag a device-invariant array as varying over ``vma`` (no-op where
+    the installed jax has no vma typing)."""
+    if not vma:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, vma, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, vma)
+    return x

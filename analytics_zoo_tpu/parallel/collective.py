@@ -36,7 +36,7 @@ def reduce_scatter(x, axis: AxisName = "dp", *, scatter_dimension=0):
 
 def ppermute_shift(x, axis: AxisName = "sp", shift: int = 1):
     """Ring shift along an axis — building block for ring attention."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -46,7 +46,8 @@ def axis_index(axis: AxisName = "dp"):
 
 
 def axis_size(axis: AxisName = "dp"):
-    return lax.axis_size(axis)
+    from ._compat import axis_size as _axis_size
+    return _axis_size(axis)
 
 
 def grad_allreduce_mean(grads: Any, axes: Sequence[str] = ("dp", "fsdp")) -> Any:

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map                # jax >= 0.8
+from ._compat import shard_map           # jax-version-tolerant facade
 
 
 def stack_expert_params(per_expert) -> Any:

@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map               # jax >= 0.8 (check_vma kwarg)
+from ._compat import shard_map          # jax-version-tolerant facade
 
 
 def stack_stage_params(per_stage: List[Any]) -> Any:

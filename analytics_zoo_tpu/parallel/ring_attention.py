@@ -44,22 +44,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    n = lax.axis_size(axis_name)
+    from ._compat import axis_size
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
 
     q_positions = idx * s_local + jnp.arange(s_local)
     # Accumulators must carry the inputs' varying-axes type (jax >= 0.9
-    # shard_map vma typing) or the scan carry is rejected; pvary marks the
-    # device-invariant zeros as varying over every manual axis in scope.
-    vma = tuple(getattr(jax.typeof(q), "vma", frozenset()) |
-                getattr(jax.typeof(k), "vma", frozenset()))
-    if hasattr(lax, "pcast"):
-        def _vary(x):
-            return lax.pcast(x, vma, to="varying")
-    else:                                   # jax < pcast introduction
-        def _vary(x):
-            return lax.pvary(x, vma)
+    # shard_map vma typing) or the scan carry is rejected; _compat marks the
+    # device-invariant zeros as varying over every manual axis in scope (a
+    # no-op on jax builds without vma typing).
+    from ._compat import mark_varying, varying_axes
+    vma = varying_axes(q, k)
+    _vary = partial(mark_varying, vma=vma)
     acc = _vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     m = _vary(jnp.full((b, s_local, h), -jnp.inf, jnp.float32))
     l = _vary(jnp.zeros((b, s_local, h), jnp.float32))
@@ -90,7 +87,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style): re-shard
     (B, S/sp, H, D) -> (B, S, H/sp, D), attend locally, re-shard back.
     Requires H % sp_size == 0."""
-    n = lax.axis_size(axis_name)
+    from ._compat import axis_size
+    n = axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by sp size ({n})")
@@ -116,9 +114,9 @@ def sequence_sharded_attention(mesh: Mesh, q, k, v, *, strategy: str = "ring",
         raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
     fn = ring_attention if strategy == "ring" else ulysses_attention
     spec = P("dp", "sp", None, None)
+    from ._compat import shard_map
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-             out_specs=spec)
+    @shard_map(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def _run(ql, kl, vl):
         return fn(ql, kl, vl, axis_name="sp", causal=causal,
                   sm_scale=sm_scale)

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Canonical tier-1 verify entrypoint (ROADMAP.md "Tier-1 verify").
+#
+# Runs the fast test suite on the CPU backend exactly the way the driver
+# does — builders and CI should invoke THIS script rather than hand-rolling
+# the pytest line, so the marker filter, plugin set, and DOTS_PASSED
+# accounting stay in one place.
+#
+# Env overrides:
+#   T1_TIMEOUT  seconds before the run is killed (default 870)
+#   T1_LOG      log path (default /tmp/_t1.log)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG="${T1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 "${T1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+# progress-line chars: . pass, F fail, E error, s skip, x xfail, X xpass
+echo DOTS_PASSED=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+exit $rc

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from analytics_zoo_tpu.parallel._compat import shard_map
 from analytics_zoo_tpu.ops.attention import flash_attention, mha_reference
 from analytics_zoo_tpu.parallel.ring_attention import (
     ring_attention, sequence_sharded_attention, ulysses_attention)
@@ -159,7 +160,7 @@ def test_sequence_parallel_matches_full(strategy, causal):
     spec = P("dp", "sp", None, None)
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    @shard_map(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def run(ql, kl, vl):
         return fn(ql, kl, vl, axis_name="sp", causal=causal)
 
@@ -174,7 +175,7 @@ def test_ring_attention_grads():
     spec = P(None, "sp", None, None)
 
     def loss_ring(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda ql, kl, vl: ring_attention(ql, kl, vl, axis_name="sp",
                                               causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
@@ -199,7 +200,7 @@ def test_ulysses_flash_kernel_path():
     spec = P("dp", "sp", None, None)
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    @shard_map(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def run(ql, kl, vl):
         return ulysses_attention(ql, kl, vl, axis_name="sp", causal=True,
                                  use_flash=True)
@@ -214,7 +215,7 @@ def test_flash_mixed_vma_cross_attention():
     mesh = _sp_mesh()
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(P(), P(None, "sp"), P(None, "sp")),
+    @shard_map(mesh=mesh, in_specs=(P(), P(None, "sp"), P(None, "sp")),
                    out_specs=P("sp"))
     def run(ql, kl, vl):
         # local full attention on each device's k/v shard — the point is

@@ -1,0 +1,502 @@
+"""The zero-copy XShards data plane + pipelined instrumented infeed.
+
+Pins the PR-1 contracts: (1) batch streams built on chunked shards are
+bit-identical to the old merge-everything path for the same seed; (2) the
+training path never materializes a full-dataset copy (epoch setup is
+O(batch × depth), not O(dataset)); (3) repartition/partition_by produce the
+same row sets as the reference merge-then-split implementations they
+replaced; (4) the InfeedPump survives slow consumers, producer exceptions
+and abandoned epochs, and adapts its depth; (5) ``data_pipeline_stats()``
+reports nonzero assemble/H2D/step timers after a real ``fit()``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.native.infeed import InfeedPump, PipelineStats
+from analytics_zoo_tpu.orca.data import HostXShards, XShards
+from analytics_zoo_tpu.orca.data.chunked import ChunkedArray, as_chunked
+from analytics_zoo_tpu.orca.learn import utils as learn_utils
+
+
+# --------------------------------------------------------------------------
+# ChunkedArray core
+# --------------------------------------------------------------------------
+
+def _ragged_chunks(rng, sizes=(5, 0, 7, 12), width=3):
+    return [rng.rand(k, width).astype(np.float32) for k in sizes]
+
+
+def test_chunked_gather_matches_concat():
+    rng = np.random.RandomState(0)
+    chunks = _ragged_chunks(rng)
+    ca = ChunkedArray(chunks)
+    ref = np.concatenate(chunks)
+    assert len(ca) == len(ref) and ca.shape == ref.shape
+    patterns = [np.arange(24),                      # full contiguous
+                np.arange(3, 9),                    # seam-crossing run
+                np.arange(5, 10),                   # inside one chunk
+                rng.randint(0, 24, 50),             # shuffled with repeats
+                np.array([23, 0, 5, 5]),            # unsorted + dup
+                np.arange(0, 24, 3)]                # strided
+    for idx in patterns:
+        np.testing.assert_array_equal(ca.gather(idx), ref[idx])
+    np.testing.assert_array_equal(ca[2:9], ref[2:9])
+    np.testing.assert_array_equal(ca[7], ref[7])
+    np.testing.assert_array_equal(ca[-1], ref[-1])
+
+
+def test_chunked_inchunk_slice_is_zero_copy():
+    rng = np.random.RandomState(1)
+    chunks = _ragged_chunks(rng)
+    ca = ChunkedArray(chunks)
+    view = ca.gather(np.arange(5, 10))      # rows 5..10 live in chunks[2]
+    assert np.shares_memory(view, chunks[2])
+    assert ca.materializations == 0
+
+
+def test_chunked_negative_and_oob_indices_match_ndarray():
+    rng = np.random.RandomState(9)
+    chunks = _ragged_chunks(rng)
+    ca = ChunkedArray(chunks)
+    ref = np.concatenate(chunks)
+    for idx in ([-1], [-2, 5], [-24, 23], [0, -5, -5]):
+        np.testing.assert_array_equal(ca.gather(np.array(idx)),
+                                      ref[np.array(idx)])
+    np.testing.assert_array_equal(ca[-3], ref[-3])
+    with pytest.raises(IndexError):
+        ca.gather(np.array([24]))
+    with pytest.raises(IndexError):
+        ca.gather(np.array([-25]))
+    with pytest.raises(IndexError):
+        ca[24]
+    # single-chunk arrays go through the native gather — same contract
+    one = ChunkedArray([chunks[3]])
+    np.testing.assert_array_equal(one.gather(np.array([-2, 5])),
+                                  chunks[3][np.array([-2, 5])])
+    with pytest.raises(IndexError):
+        one.gather(np.array([12]))
+
+
+def test_chunked_mixed_dtype_promotes_like_concat():
+    a = np.arange(4, dtype=np.int32)
+    b = np.arange(3, dtype=np.float64)
+    ca = ChunkedArray([a, b])
+    ref = np.concatenate([a, b])
+    assert ca.dtype == ref.dtype
+    np.testing.assert_array_equal(ca.gather(np.arange(7)), ref)
+
+
+# --------------------------------------------------------------------------
+# repartition / partition_by equivalence vs the old merge-based reference
+# --------------------------------------------------------------------------
+
+def _old_repartition_dict(parts, n):
+    """The pre-chunking implementation: concatenate all rows, array_split."""
+    merged = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+    total = len(next(iter(merged.values())))
+    return [{k: v[idx] for k, v in merged.items()}
+            for idx in np.array_split(np.arange(total), n)]
+
+
+def test_repartition_matches_old_impl(orca_context):
+    rng = np.random.RandomState(2)
+    parts = [{"a": rng.rand(k, 2).astype(np.float32),
+              "b": rng.randint(0, 9, k)} for k in (11, 3, 20, 7)]
+    shards = HostXShards([dict(p) for p in parts])
+    for n in (1, 2, 3, 5):
+        new = shards.repartition(n).collect()
+        old = _old_repartition_dict(parts, n)
+        assert len(new) == len(old)
+        for pn, po in zip(new, old):
+            np.testing.assert_array_equal(pn["a"], po["a"])
+            np.testing.assert_array_equal(pn["b"], po["b"])
+
+
+def test_repartition_outputs_do_not_alias_sources(orca_context):
+    """Computed on chunk indices (no merged copy), but each output
+    partition owns its memory: in-place mutation of a partition must never
+    write through to the source shards (the old merge+split guarantee)."""
+    src = np.arange(40, dtype=np.float32).reshape(40, 1)
+    shards = HostXShards([{"a": src[:30].copy()}, {"a": src[30:].copy()}])
+    base0 = shards.collect()[0]["a"]
+    out = shards.repartition(3).collect()
+    assert not np.shares_memory(out[0]["a"], base0)
+    out[0]["a"][0, 0] = 999.0
+    assert base0[0, 0] == 0.0
+
+
+def test_repartition_pandas_matches_old_impl(orca_context):
+    rng = np.random.RandomState(3)
+    dfs = [pd.DataFrame({"u": rng.randint(0, 50, k),
+                         "v": rng.rand(k)}) for k in (9, 14, 2)]
+    shards = HostXShards([df.copy() for df in dfs])
+    merged = pd.concat(dfs, ignore_index=True)
+    for n in (2, 4):
+        new = shards.repartition(n).collect()
+        old = [merged.iloc[idx].reset_index(drop=True)
+               for idx in np.array_split(np.arange(len(merged)), n)]
+        for pn, po in zip(new, old):
+            pd.testing.assert_frame_equal(pn, po)
+
+
+def test_partition_by_matches_old_impl(orca_context):
+    rng = np.random.RandomState(4)
+    dfs = [pd.DataFrame({"user": rng.randint(0, 30, k),
+                         "val": rng.rand(k)}) for k in (17, 8, 25)]
+    shards = HostXShards([df.copy() for df in dfs])
+    n = 4
+    new = shards.partition_by("user", num_partitions=n).collect()
+    # old implementation: merge, hash, mask
+    merged = pd.concat(dfs, ignore_index=True)
+    keys = pd.util.hash_pandas_object(merged[["user"]],
+                                      index=False).to_numpy()
+    old = [merged[keys % n == i].reset_index(drop=True) for i in range(n)]
+    total = 0
+    for pn, po in zip(new, old):
+        pd.testing.assert_frame_equal(pn, po)
+        total += len(pn)
+    assert total == len(merged)
+    # same-key rows land in the same partition
+    for p in new:
+        for u in p["user"].unique():
+            assert sum(int((q["user"] == u).any()) for q in new) == 1
+
+
+# --------------------------------------------------------------------------
+# lazy transform_shard with stage fusion
+# --------------------------------------------------------------------------
+
+def test_transform_shard_is_lazy_and_fuses(orca_context):
+    data = {"x": np.arange(64, dtype=np.float32).reshape(64, 1),
+            "y": np.zeros(64)}
+    shards = XShards.partition(data, num_shards=4)
+    calls = {"s1": 0, "s2": 0, "s3": 0}
+    lock = threading.Lock()
+
+    def stage(name, fn):
+        def run(p):
+            with lock:
+                calls[name] += 1
+            return fn(p)
+        return run
+
+    t = (shards
+         .transform_shard(stage("s1", lambda d: {**d, "x": d["x"] * 2}))
+         .transform_shard(stage("s2", lambda d: {**d, "x": d["x"] + 1}))
+         .transform_shard(stage("s3", lambda d: {**d, "x": d["x"] * 10})))
+    # nothing ran yet, and partition count is known without materializing
+    assert t.num_partitions() == 4
+    assert all(v == 0 for v in calls.values())
+    out = t.collect()
+    # one fused pass per partition per stage — not k pool dispatches
+    assert all(v == 4 for v in calls.values())
+    got = np.sort(np.concatenate([p["x"][:, 0] for p in out]))
+    np.testing.assert_allclose(
+        got, np.sort((np.arange(64, dtype=np.float32) * 2 + 1) * 10))
+    # the source shards stayed untouched
+    src = np.sort(np.concatenate([p["x"][:, 0] for p in shards.collect()]))
+    np.testing.assert_allclose(src, np.arange(64, dtype=np.float32))
+
+
+def test_transform_stages_run_exactly_once_any_read_order(orca_context):
+    """In-place transform functions (the common orca user idiom) must keep
+    eager semantics: every stage applies exactly once per partition no
+    matter which node of the chain is read first."""
+    for read_child_first in (True, False):
+        shards = XShards.partition(
+            {"a": np.ones(8, dtype=np.float32)}, num_shards=2)
+
+        def f(p):
+            p["a"] *= 2          # in-place, returns the same dict
+            return p
+
+        def g(p):
+            p["a"] += 1
+            return p
+
+        s2 = shards.transform_shard(f)
+        s3 = s2.transform_shard(g)
+        if read_child_first:
+            c3, c2 = s3.collect(), s2.collect()
+        else:
+            c2, c3 = s2.collect(), s3.collect()
+        # exactly-once: a*2 == 2 at s2, +1 == 3 at s3 (never 4/5)
+        assert {float(p["a"][0]) for p in c3} == {3.0}, read_child_first
+
+
+def test_chunked_boolean_mask_matches_ndarray():
+    chunks = [np.arange(10, 15), np.arange(15, 20)]
+    ca = ChunkedArray(chunks)
+    ref = np.concatenate(chunks)
+    mask = (ref % 2).astype(bool)
+    np.testing.assert_array_equal(ca[mask], ref[mask])
+    np.testing.assert_array_equal(ca.gather(np.zeros(10, bool)),
+                                  ref[np.zeros(10, bool)])
+    with pytest.raises(IndexError):
+        ca[np.array([True, False])]
+
+
+def test_transform_chains_do_not_interfere(orca_context):
+    shards = XShards.partition({"x": np.arange(10, dtype=np.float32)},
+                               num_shards=2)
+    a = shards.transform_shard(lambda d: {"x": d["x"] + 1})
+    b = shards.transform_shard(lambda d: {"x": d["x"] * 3})
+    ga = np.sort(np.concatenate([p["x"] for p in a.collect()]))
+    gb = np.sort(np.concatenate([p["x"] for p in b.collect()]))
+    np.testing.assert_allclose(ga, np.arange(10) + 1)
+    np.testing.assert_allclose(gb, np.sort(np.arange(10) * 3))
+
+
+# --------------------------------------------------------------------------
+# batch-stream equivalence + no-full-copy guarantee
+# --------------------------------------------------------------------------
+
+def _ragged_shards(rng, sizes=(33, 17, 50)):
+    return HostXShards([
+        {"x": (rng.rand(k, 4).astype(np.float32),
+               rng.rand(k, 2).astype(np.float32)),
+         "y": (rng.randint(0, 2, k),)} for k in sizes])
+
+
+def _assert_batches_equal(b1, b2):
+    for a1, a2 in zip(b1.x, b2.x):
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    for a1, a2 in zip(b1.y or (), b2.y or ()):
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert (b1.w is None) == (b2.w is None)
+    if b1.w is not None:
+        np.testing.assert_array_equal(np.asarray(b1.w), np.asarray(b2.w))
+    assert b1.fused == b2.fused
+
+
+@pytest.mark.parametrize("shuffle", [False, True])
+@pytest.mark.parametrize("fuse", [1, 2])
+def test_batch_stream_bit_identical_chunked_vs_merged(orca_context, shuffle,
+                                                      fuse):
+    """Same seed -> the chunked assembler emits exactly the batches the old
+    concat-everything iterator emitted, across epochs, fused or not."""
+    rng = np.random.RandomState(5)
+    shards = _ragged_shards(rng)
+    mesh = orca_context.mesh
+    it_new = learn_utils.BatchIterator(
+        learn_utils.chunk_shards(shards), 32, mesh, seed=9)
+    it_old = learn_utils.BatchIterator(
+        learn_utils.concat_shards(shards), 32, mesh, seed=9)
+    for _ in range(2):                      # shuffle order advances per epoch
+        n = 0
+        for b1, b2 in zip(it_new._host_batches(shuffle, fuse),
+                          it_old._host_batches(shuffle, fuse)):
+            _assert_batches_equal(b1, b2)
+            n += 1
+        assert n > 0
+
+
+def test_training_path_never_materializes_dataset(orca_context):
+    """Acceptance: epoch setup must not merge the dataset. The iterator's
+    leaves stay chunked (materializations == 0 after full epochs) and a
+    full in-chunk batch is a zero-copy view of the shard's own array."""
+    rng = np.random.RandomState(6)
+    parts = [{"x": (rng.rand(k, 4).astype(np.float32),),
+              "y": (rng.randint(0, 2, k),)} for k in (64, 96)]
+    shards = HostXShards(parts)
+    it = learn_utils.data_to_iterator(shards, 32, orca_context.mesh)
+    for leaf in it.x:
+        assert isinstance(leaf, ChunkedArray)
+    batches = list(it._host_batches(False))
+    assert all(leaf.materializations == 0 for leaf in it.x + (it.y or ()))
+    # sequential batch 0 covers rows 0..32 of the 64-row first chunk: view
+    assert np.shares_memory(batches[0].x[0], parts[0]["x"][0])
+
+
+def test_xshards_fit_peak_assembly_is_per_batch(orca_context):
+    """np.concatenate during an epoch only ever touches O(batch) rows (chunk
+    seams + index pads), never the dataset."""
+    rng = np.random.RandomState(7)
+    shards = _ragged_shards(rng, sizes=(40, 40, 40, 40))
+    it = learn_utils.data_to_iterator(shards, 32, orca_context.mesh,
+                                      shuffle=True)
+    seen = []
+    orig = np.concatenate
+
+    def spy(arrays, *a, **k):
+        out = orig(arrays, *a, **k)
+        seen.append(out.shape[0] if out.ndim else 0)
+        return out
+
+    np.concatenate = spy
+    try:
+        n = sum(1 for _ in it._host_batches(True))
+    finally:
+        np.concatenate = orig
+    assert n == 5
+    assert max(seen, default=0) <= 32       # per-batch, not per-epoch
+
+
+# --------------------------------------------------------------------------
+# InfeedPump stress
+# --------------------------------------------------------------------------
+
+def test_pump_task_fanout_preserves_order():
+    rng = np.random.RandomState(8)
+    delays = rng.rand(20) * 0.01
+
+    def factory():
+        for i in range(20):
+            def task(i=i):
+                time.sleep(delays[i])       # jittered assembly
+                return np.full((2,), i, np.float32)
+            yield task
+
+    stats = PipelineStats()
+    seen = [int(np.asarray(b)[0])
+            for b in InfeedPump(factory, depth=3, workers=4, stats=stats)]
+    assert seen == list(range(20))
+    snap = stats.snapshot()
+    assert snap["assemble_n"] == 20 and snap["assemble_s"] > 0
+    assert snap["h2d_n"] == 20
+
+
+def test_pump_task_exception_propagates():
+    def factory():
+        yield lambda: np.ones(2)
+
+        def boom():
+            raise RuntimeError("assembly exploded")
+        yield boom
+        yield lambda: np.ones(2)
+
+    with pytest.raises(RuntimeError, match="assembly exploded"):
+        list(InfeedPump(factory, workers=2))
+
+
+def test_pump_slow_consumer_tasks_complete():
+    def factory():
+        for i in range(4):
+            yield lambda i=i: np.full((2,), i, np.float32)
+
+    seen = []
+    for b in InfeedPump(factory, depth=2, workers=2):
+        if not seen:
+            time.sleep(0.3)     # producer fills + finishes meanwhile
+        seen.append(float(np.asarray(b)[0]))
+    assert seen == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_pump_early_exit_stops_producer():
+    produced = []
+
+    def factory():
+        for i in range(200):
+            def task(i=i):
+                produced.append(i)
+                time.sleep(0.002)
+                return np.full((2,), i, np.float32)
+            yield task
+
+    it = iter(InfeedPump(factory, depth=2, workers=2))
+    next(it)
+    next(it)
+    it.close()                  # abandon mid-epoch
+    time.sleep(0.2)
+    n_after_close = len(produced)
+    time.sleep(0.2)
+    # producer stopped: nothing new gets assembled after close settles
+    assert len(produced) == n_after_close
+    assert n_after_close < 200
+
+
+def test_pump_adaptive_depth_grows_when_starved():
+    def factory():
+        for i in range(12):
+            def task(i=i):
+                time.sleep(0.03)            # slow assembly -> starved consumer
+                return np.full((1024,), i, np.float32)
+            yield task
+
+    stats = PipelineStats()
+    list(InfeedPump(factory, depth=1, workers=1, stats=stats))
+    snap = stats.snapshot()
+    assert snap["stall_s"] > 0
+    assert snap["depth_peak"] > 1 and snap["depth_growths"] >= 1
+
+
+def test_pump_depth_bounded_by_memory_budget():
+    def factory():
+        for i in range(6):
+            def task(i=i):
+                time.sleep(0.02)
+                return np.zeros(1 << 20, np.float32)    # 4 MB batches
+            yield task
+
+    stats = PipelineStats()
+    list(InfeedPump(factory, depth=1, workers=1, stats=stats,
+                    host_mem_budget=8 << 20))           # budget = 2 batches
+    assert stats.snapshot()["depth_peak"] <= 2
+
+
+def test_pump_legacy_batch_factory_still_works():
+    batches = [np.full((2, 2), i, np.float32) for i in range(10)]
+    seen = [np.asarray(b)[0, 0] for b in InfeedPump(lambda: iter(batches),
+                                                    depth=3)]
+    assert seen == list(range(10))
+
+
+# --------------------------------------------------------------------------
+# estimator-level acceptance: stats after fit() on the synthetic NCF config
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("via_shards", [False, True])
+def test_fit_populates_data_pipeline_stats(orca_context, via_shards):
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.orca.learn.optimizers import Adam
+
+    rng = np.random.RandomState(0)
+    n_users, n_items, n = 60, 40, 512
+    pairs = np.stack([rng.randint(1, n_users, n),
+                      rng.randint(1, n_items, n)], -1).astype(np.int32)
+    ratings = rng.randint(0, 5, n).astype(np.int32)
+    model = NeuralCF(user_count=n_users, item_count=n_items, class_num=5,
+                     user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                     mf_embed=8, compute_dtype=jnp.float32)
+    model.compile(loss="sparse_categorical_crossentropy",
+                  optimizer=Adam(lr=1e-3), metrics=None)
+    est = model.estimator
+    if via_shards:
+        data = HostXShards([{"x": (pairs[:200],), "y": (ratings[:200],)},
+                            {"x": (pairs[200:],), "y": (ratings[200:],)}])
+    else:
+        data = {"x": pairs, "y": ratings}
+    est.fit(data, epochs=1, batch_size=64, verbose=False)
+    stats = est.data_pipeline_stats()
+    assert stats["assemble_s"] > 0 and stats["assemble_n"] > 0
+    assert stats["h2d_s"] > 0 and stats["h2d_bytes"] > 0
+    assert stats["step_s"] > 0 and stats["step_n"] >= 8
+    # reset surface works (fit(validation_data=...) and repeat fits reuse it)
+    est.data_pipeline_stats(reset=True)
+    assert est.data_pipeline_stats()["assemble_n"] == 0
+
+
+def test_predict_path_uses_chunked_assembly(orca_context):
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    rng = np.random.RandomState(1)
+    shards = HostXShards([{"x": (rng.rand(k, 5).astype(np.float32),)}
+                          for k in (21, 43)])
+    est = TPUEstimator(Tiny(), loss="mse", optimizer="adam")
+    out = est.predict(shards, batch_size=16)
+    preds = out.collect()
+    assert [len(p["prediction"]) for p in preds] == [21, 43]
