@@ -8,6 +8,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .model_builder import ModelBuilder
 from .search.search_engine import TPUSearchEngine
+from .search.search_engine import UNSET as _UNSET
 
 
 class AutoEstimator:
@@ -46,8 +47,17 @@ class AutoEstimator:
             metric: Optional[str] = None, metric_mode: Optional[str] = None,
             metric_threshold=None, n_sampling: int = 1,
             search_space: Optional[Dict] = None, search_alg=None,
-            scheduler=None, **_) -> "AutoEstimator":
-        """(reference: auto_estimator.py:99)"""
+            scheduler=None, scheduler_params: Optional[Dict] = None,
+            keep_model_states=_UNSET, **_) -> "AutoEstimator":
+        """(reference: auto_estimator.py:99)
+
+        ``scheduler="asha"`` runs trials through the fault-tolerant rung
+        scheduler (``automl.scheduler.TrialRuntime``): ``epochs`` becomes
+        the max per-trial budget, losing trials pause at rung boundaries
+        via checkpoint and only the top 1/eta train on; ``scheduler_params``
+        tunes {eta, grace_period, max_trial_retries, retry_backoff_s}.
+        ``metric_threshold`` maps to the engine's ``stop_score`` (the
+        reference's tune stop condition)."""
         if self._fitted:
             raise RuntimeError(
                 "This AutoEstimator has already been fitted and cannot fit "
@@ -59,10 +69,19 @@ class AutoEstimator:
         self.searcher.compile(data, self.model_builder, search_space or {},
                               n_sampling=n_sampling, epochs=epochs,
                               validation_data=validation_data, metric=metric,
-                              metric_mode=metric_mode)
+                              metric_mode=metric_mode, search_alg=search_alg,
+                              stop_score=metric_threshold,
+                              scheduler=scheduler,
+                              scheduler_params=scheduler_params,
+                              keep_model_states=keep_model_states)
         self.searcher.run()
         self._fitted = True
         return self
+
+    def search_summary(self) -> Dict:
+        """Study telemetry (scheduler rungs/counters/chip utilization when
+        scheduler='asha' ran; basic completion stats otherwise)."""
+        return self.searcher.summary()
 
     def get_best_model(self):
         """Rebuild the winning trial's estimator with its trained weights

@@ -82,16 +82,67 @@ class TrialModel:
         return est
 
     def fit_eval(self, data, validation_data=None, epochs: int = 1,
-                 metric: str = "mse") -> Tuple[float, Dict, Any]:
-        est = self.estimator = self._build_estimator(metric)
+                 metric: str = "mse", state: Any = None,
+                 trial_context=None) -> Tuple[float, Dict, Any]:
+        """Train to a (cumulative) epoch budget and score on validation data.
+
+        Extended scheduler protocol (both kwargs optional — legacy callers
+        see the original behavior):
+
+        * ``state`` — a state dict from a previous fit_eval call
+          (``TrainEngine.get_state()`` + ``epochs_done``): training resumes
+          from it and ``epochs`` is the *cumulative* target, so a trial
+          paused at epoch 3 and resumed with ``epochs=9`` trains 6 more.
+          Resumed training is bit-equivalent to an uninterrupted run: the
+          engine step counter (dropout rng) rides in the state and the
+          shuffle-seed epoch counter is re-aligned via ``fit(...,
+          initial_epoch=...)``.
+        * ``trial_context`` — a ``scheduler.TrialContext``: training runs
+          segment-by-segment between rung boundaries, reporting the
+          validation score at each boundary; the scheduler may raise
+          ``TrialPaused``/``TrialPreempted`` out of ``report``/``heartbeat``
+          after capturing a checkpoint via ``set_state_fn``.
+        """
+        est = self.estimator = self.estimator or self._build_estimator(metric)
         batch_size = int(self.config.get("batch_size", 32))
         data = data(self.config, batch_size) if callable(data) else data
         if validation_data is None:
             validation_data = data
         elif callable(validation_data):
             validation_data = validation_data(self.config, batch_size)
-        est.fit(data, epochs=epochs, batch_size=batch_size, verbose=False)
-        result = est.evaluate(validation_data, batch_size=batch_size,
-                              verbose=False)
-        score = result.get(metric, result.get("loss"))
-        return float(score), result, est.engine.get_state()
+        epochs_done = 0
+        if state is not None:
+            est.engine.set_state(state)
+            epochs_done = int(state.get("epochs_done", 0))
+
+        def snapshot():
+            s = est.engine.get_state()
+            s["epochs_done"] = epochs_done
+            return s
+
+        if trial_context is not None:
+            trial_context.set_state_fn(snapshot)
+        total = int(epochs)
+        result = None
+        while epochs_done < total:
+            if trial_context is not None:
+                trial_context.heartbeat(epochs_done)
+                boundary = min(total,
+                               trial_context.next_boundary(epochs_done)
+                               or total)
+            else:
+                boundary = total
+            est.fit(data, epochs=boundary - epochs_done,
+                    batch_size=batch_size, verbose=False,
+                    initial_epoch=epochs_done)
+            epochs_done = boundary
+            result = est.evaluate(validation_data, batch_size=batch_size,
+                                  verbose=False)
+            score = result.get(metric, result.get("loss"))
+            if trial_context is not None:
+                trial_context.report(epochs_done, float(score))
+        if result is None:      # resumed at/past the budget: score only
+            result = est.evaluate(validation_data, batch_size=batch_size,
+                                  verbose=False)
+            score = result.get(metric, result.get("loss"))
+        return float(score), result, snapshot()

@@ -1,0 +1,142 @@
+"""Chip leasing for AutoML trials.
+
+The pre-scheduler engine pinned a trial to ``devices[trial_id %
+len(devices)]`` — two in-flight trials could land on one chip whenever
+``max_concurrent > len(devices)`` while other chips sat idle.
+``DeviceLeaseManager`` replaces the modulo with real ownership: it holds
+the local device inventory, hands out at most one lease per chip, and
+blocks further acquires until a lease is returned. A lease carries the
+single-device ``Mesh`` the trial trains on, so holders never touch raw
+devices.
+
+Telemetry rides along: the manager records per-chip busy seconds and
+lease counts, which ``TrialRuntime.summary()`` surfaces as chip
+utilization.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["DeviceLease", "DeviceLeaseManager", "LeaseTimeout"]
+
+
+class LeaseTimeout(RuntimeError):
+    """No chip became free within the acquire timeout."""
+
+
+class DeviceLease:
+    """One chip, exclusively held. Context manager; releases on exit."""
+
+    def __init__(self, manager: "DeviceLeaseManager", device, index: int,
+                 owner: Any):
+        self._manager = manager
+        self.device = device
+        self.index = index
+        self.owner = owner
+        self.acquired_at = time.perf_counter()
+        self._released = False
+
+    @property
+    def mesh(self):
+        import numpy as np
+        from jax.sharding import Mesh
+        return Mesh(np.asarray([self.device]).reshape(1, 1, 1, 1),
+                    ("dp", "fsdp", "tp", "sp"))
+
+    def release(self):
+        self._manager.release(self)
+
+    def __enter__(self) -> "DeviceLease":
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"DeviceLease(chip={self.index}, owner={self.owner!r}, "
+                f"device={self.device})")
+
+
+class DeviceLeaseManager:
+    """Thread-safe exclusive allocator over the local chip inventory."""
+
+    def __init__(self, devices: Optional[List] = None):
+        if devices is None:
+            import jax
+            devices = jax.local_devices()
+        if not devices:
+            raise ValueError("DeviceLeaseManager needs at least one device")
+        self._devices = list(devices)
+        self._cond = threading.Condition()
+        self._free = list(range(len(self._devices)))
+        self._held: Dict[int, DeviceLease] = {}
+        self._busy_s = [0.0] * len(self._devices)
+        self._lease_counts = [0] * len(self._devices)
+        self._created_at = time.perf_counter()
+
+    def __len__(self):
+        return len(self._devices)
+
+    @property
+    def devices(self) -> List:
+        return list(self._devices)
+
+    def acquire(self, owner: Any = None,
+                timeout: Optional[float] = None) -> DeviceLease:
+        """Block until a chip is free, then lease it exclusively."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._cond:
+            while not self._free:
+                remaining = (None if deadline is None
+                             else deadline - time.perf_counter())
+                if remaining is not None and remaining <= 0:
+                    raise LeaseTimeout(
+                        f"no chip free within {timeout:.1f}s "
+                        f"({len(self._held)} leases outstanding)")
+                self._cond.wait(remaining)
+            idx = self._free.pop()
+            lease = DeviceLease(self, self._devices[idx], idx, owner)
+            self._held[idx] = lease
+            self._lease_counts[idx] += 1
+            return lease
+
+    def release(self, lease: DeviceLease):
+        with self._cond:
+            if lease._released:
+                return
+            held = self._held.get(lease.index)
+            if held is not lease:
+                raise RuntimeError(
+                    f"lease for chip {lease.index} is not outstanding "
+                    "(double release or foreign lease)")
+            lease._released = True
+            del self._held[lease.index]
+            self._busy_s[lease.index] += (time.perf_counter()
+                                          - lease.acquired_at)
+            self._free.append(lease.index)
+            self._cond.notify()
+
+    def outstanding(self) -> List[DeviceLease]:
+        with self._cond:
+            return list(self._held.values())
+
+    def utilization(self) -> Dict[str, Any]:
+        """Per-chip busy time since the manager was created."""
+        with self._cond:
+            now = time.perf_counter()
+            wall = max(now - self._created_at, 1e-9)
+            busy = list(self._busy_s)
+            for idx, lease in self._held.items():
+                busy[idx] += now - lease.acquired_at
+            return {
+                "wall_s": round(wall, 3),
+                "chips": len(self._devices),
+                "busy_s": [round(b, 3) for b in busy],
+                "leases": list(self._lease_counts),
+                "utilization": round(sum(busy) / (wall * len(self._devices)),
+                                     4),
+            }

@@ -1,21 +1,33 @@
-"""HPO search engine with chip-pinned trials.
+"""HPO search engine with chip-leased trials.
 
 The reference's engine is Ray Tune (pyzoo/zoo/automl/search/
 ray_tune_search_engine.py:34: compile() builds a trainable from a ModelBuilder
 + search space, run() launches trials as Ray actors with resources_per_trial).
 The TPU-native engine removes Ray: trials are sampled from the hp DSL (random
-+ grid), executed on a thread pool where **each trial is pinned to one local
-chip** via a single-device Mesh (BASELINE config #4: AutoML trials sharded
-over TPU chips) — numpy data loading overlaps because the heavy work is in
-XLA, which releases the GIL.
++ grid) and executed on local chips, **each trial exclusively leasing one
+chip** through ``scheduler.DeviceLeaseManager`` (BASELINE config #4: AutoML
+trials sharded over TPU chips) — numpy data loading overlaps because the
+heavy work is in XLA, which releases the GIL.
+
+Three execution modes:
+
+* default — trials train their full epoch budget on a thread pool (one
+  leased chip each); ``stop_score`` cancels not-yet-started trials once a
+  completed one reaches the threshold.
+* ``search_alg="bayes"`` — sequential GP-EI proposal loop.
+* ``scheduler="asha"`` — the fault-tolerant rung scheduler
+  (``automl.scheduler.TrialRuntime``): mid-training reports, pause/resume
+  via checkpoint, retry-with-backoff, SIGTERM study preemption + manifest
+  resume. See docs/automl_scheduler.md.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import time
 import traceback
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import CancelledError, ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -25,6 +37,10 @@ from .. import hp as hp_dsl
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
+# "parameter not passed" sentinel: keep_model_states=None is a meaningful
+# value (keep every state), so compile()/fit() can't use None for "inherit"
+UNSET = object()
+
 
 @dataclass
 class Trial:
@@ -32,11 +48,15 @@ class Trial:
     config: Dict[str, Any]
     metric_value: Optional[float] = None
     metrics: Dict[str, float] = field(default_factory=dict)
-    state: str = "pending"  # pending | running | done | error
+    state: str = "pending"  # pending | running | paused | done | error | cancelled
     error: Optional[str] = None
     duration_s: float = 0.0
     model_state: Any = None
     device: Any = None
+    # scheduler bookkeeping (stays at defaults on the non-scheduler paths)
+    epochs_trained: int = 0
+    rung: int = -1
+    retries: int = 0
 
 
 class SearchEngine:
@@ -55,22 +75,36 @@ class SearchEngine:
 class TPUSearchEngine(SearchEngine):
     def __init__(self, max_concurrent: Optional[int] = None,
                  name: str = "auto_estimator", seed: int = 42,
-                 logs_dir: Optional[str] = None):
+                 logs_dir: Optional[str] = None,
+                 scheduler: Optional[str] = None,
+                 scheduler_params: Optional[Dict[str, Any]] = None,
+                 keep_model_states: Optional[int] = 1):
         self.name = name
         self.seed = seed
         self.max_concurrent = max_concurrent
         self.logs_dir = logs_dir
+        self.scheduler = scheduler
+        self.scheduler_params = scheduler_params
+        self.keep_model_states = keep_model_states
         self._trials: List[Trial] = []
         self._compiled = False
+        self._scheduler_summary: Optional[Dict[str, Any]] = None
+        self._state_lock = threading.Lock()
 
     def compile(self, data, model_builder: Callable[[Dict], Any],
                 search_space: Dict[str, Any], n_sampling: int = 1,
                 epochs: int = 1, validation_data=None, metric: str = "mse",
                 metric_mode: str = "min", batch_size_key: str = "batch_size",
                 search_alg: Optional[str] = None,
-                stop_score: Optional[float] = None):
+                stop_score: Optional[float] = None,
+                scheduler: Optional[str] = None,
+                scheduler_params: Optional[Dict[str, Any]] = None,
+                keep_model_states: Any = UNSET):
         """model_builder(config, device_mesh) -> object with
         fit_eval(data, validation_data, epochs, metric) -> (score, state).
+        The runtime also understands the extended fit_eval protocol
+        (``state=`` / ``trial_context=`` kwargs, detected by signature) —
+        see automl/scheduler/runtime.py.
 
         ``search_alg="bayes"`` switches run() to a sequential GP-EI loop
         over the continuous axes (reference: ray_tune_search_engine.py:176
@@ -80,8 +114,19 @@ class TPUSearchEngine(SearchEngine):
         ``stop_score``: early-stop threshold (the reference recipes'
         ``reward_metric`` wired into tune's stop condition) — sequential
         runs stop launching trials once a completed trial reaches it
-        (<= for metric_mode 'min', >= for 'max'). Thread-pool runs ignore
-        it (trials are already in flight)."""
+        (<= for metric_mode 'min', >= for 'max'); concurrent runs cancel
+        every not-yet-started trial (marked ``cancelled``); the ASHA
+        scheduler checkpoints running trials and halts the study.
+
+        ``scheduler="asha"``: execute through the fault-tolerant rung
+        scheduler; ``epochs`` becomes the max per-trial budget (max_t) and
+        ``scheduler_params`` may set eta, grace_period, max_trial_retries,
+        retry_backoff_s.
+
+        ``keep_model_states``: retain trained ``model_state`` only for the
+        current top-k completed trials (default 1 — enough for
+        ``get_best_model``); others are dropped eagerly to bound host
+        memory. ``None`` keeps every state (pre-scheduler behavior)."""
         self.data = data
         self.validation_data = validation_data
         self.model_builder = model_builder
@@ -96,6 +141,19 @@ class TPUSearchEngine(SearchEngine):
                              "(supported: None, 'bayes')")
         self.search_alg = search_alg
         self.stop_score = stop_score
+        if scheduler is not None:
+            self.scheduler = scheduler
+        if scheduler_params is not None:
+            self.scheduler_params = scheduler_params
+        if keep_model_states is not UNSET:
+            self.keep_model_states = keep_model_states
+        if self.scheduler not in (None, "asha"):
+            raise ValueError(f"unknown scheduler {self.scheduler!r} "
+                             "(supported: None, 'asha')")
+        if self.scheduler and self.search_alg == "bayes":
+            raise ValueError(
+                "scheduler='asha' and search_alg='bayes' are exclusive: the "
+                "GP-EI loop needs sequential full-fidelity observations")
         # grid axes expand; the remaining axes are sampled n_sampling times
         grid = hp_dsl.grid_configs(search_space)
         rng = np.random.RandomState(self.seed)
@@ -107,30 +165,61 @@ class TPUSearchEngine(SearchEngine):
         self._compiled = True
         return self
 
-    def run(self) -> List[Trial]:
-        assert self._compiled, "call compile() first"
-        import jax
-        from jax.sharding import Mesh
+    # --- model_state retention (memory bound) -------------------------------
+    def _retain_model_states(self, _trial=None):
+        """Keep ``model_state`` only for the current top-k completed trials;
+        drop the rest eagerly (errored/pruned trials' states, and previous
+        leaders displaced by a better completion)."""
+        k = self.keep_model_states
+        if k is None:
+            return
+        with self._state_lock:
+            done = sorted(
+                [t for t in self._trials
+                 if t.state == "done" and t.metric_value is not None],
+                key=lambda t: t.metric_value,
+                reverse=self.metric_mode == "max")
+            keep = {id(t) for t in done[:max(int(k), 0)]}
+            for t in self._trials:
+                if t.model_state is not None and id(t) not in keep:
+                    t.model_state = None
 
-        devices = jax.local_devices()
-        workers = self.max_concurrent or len(devices)
+    def run(self, resume="auto") -> List[Trial]:
+        assert self._compiled, "call compile() first"
+        if self.scheduler == "asha":
+            return self._run_asha(resume)
+        import jax
+
+        from ..scheduler.lease import DeviceLeaseManager
+
+        leases = DeviceLeaseManager(jax.local_devices())
+        workers = self.max_concurrent or len(leases)
+        stop_flag = threading.Event()
 
         def run_trial(trial: Trial):
-            dev = devices[trial.trial_id % len(devices)]
-            trial.device = str(dev)
             trial.state = "running"
             t0 = time.time()
             try:
-                mesh = Mesh(np.asarray([dev]).reshape(1, 1, 1, 1),
-                            ("dp", "fsdp", "tp", "sp"))
-                model = self.model_builder(trial.config, mesh)
-                score, metrics, state = model.fit_eval(
-                    self.data, self.validation_data, epochs=self.epochs,
-                    metric=self.metric)
+                # exclusive chip lease (the old devices[id % n] pinning
+                # double-booked chips whenever max_concurrent > len(devices))
+                with leases.acquire(owner=trial.trial_id) as lease:
+                    if stop_flag.is_set():
+                        # stop_score was reached while this trial waited for
+                        # a chip (future.cancel() can't reach futures already
+                        # claimed by a pool worker) — drop it untrained
+                        trial.state = "cancelled"
+                        return trial
+                    trial.device = str(lease.device)
+                    model = self.model_builder(trial.config, lease.mesh)
+                    score, metrics, state = model.fit_eval(
+                        self.data, self.validation_data, epochs=self.epochs,
+                        metric=self.metric)
                 trial.metric_value = float(score)
                 trial.metrics = metrics
                 trial.model_state = state
+                trial.epochs_trained = self.epochs
                 trial.state = "done"
+                self._retain_model_states()
             except Exception as e:  # noqa: BLE001 — a failed trial is a result
                 trial.state = "error"
                 trial.error = f"{e}\n{traceback.format_exc()}"
@@ -177,7 +266,32 @@ class TPUSearchEngine(SearchEngine):
                     break
         else:
             with ThreadPoolExecutor(max_workers=workers) as pool:
-                list(pool.map(run_trial, self._trials))
+                futs = {pool.submit(run_trial, t): t for t in self._trials}
+                stopping = False
+                for fut in as_completed(futs):
+                    try:
+                        t = fut.result()
+                    except CancelledError:
+                        continue
+                    if not stopping and reached_stop(t):
+                        # threshold hit: cancel everything not yet training.
+                        # future.cancel() reaps futures the pool hasn't
+                        # claimed; the stop_flag reaps trials already claimed
+                        # but still waiting on a chip lease. Trials actually
+                        # training run to completion — threads can't be
+                        # interrupted mid-XLA-dispatch.
+                        stopping = True
+                        stop_flag.set()
+                        n_cancelled = 0
+                        for other, ot in futs.items():
+                            if other.cancel():
+                                ot.state = "cancelled"
+                                n_cancelled += 1
+                        logger.info(
+                            "stop_score %.6g reached by trial %d; "
+                            "cancelled %d queued trials (chip-waiters "
+                            "drop at lease time)",
+                            self.stop_score, t.trial_id, n_cancelled)
         done = [t for t in self._trials if t.state == "done"]
         logger.info("search finished: %d/%d trials succeeded",
                     len(done), len(self._trials))
@@ -186,13 +300,61 @@ class TPUSearchEngine(SearchEngine):
             raise RuntimeError(f"all trials failed; first errors:\n{errs}")
         return self._trials
 
-    def get_best_trial(self) -> Trial:
+    def _run_asha(self, resume="auto") -> List[Trial]:
+        import jax
+
+        from ..scheduler.runtime import TrialRuntime
+
+        params = dict(self.scheduler_params or {})
+        runtime = TrialRuntime(
+            trials=self._trials, model_builder=self.model_builder,
+            data=self.data, validation_data=self.validation_data,
+            metric=self.metric, metric_mode=self.metric_mode,
+            max_t=self.epochs, eta=params.get("eta", 3),
+            grace_period=params.get("grace_period", 1),
+            max_concurrent=self.max_concurrent,
+            max_trial_retries=params.get("max_trial_retries", 2),
+            retry_backoff_s=params.get("retry_backoff_s", 0.5),
+            logs_dir=self.logs_dir, name=self.name,
+            stop_score=self.stop_score, devices=jax.local_devices(),
+            on_trial_done=self._retain_model_states)
+        self._runtime = runtime
+        runtime.run(resume=resume)
+        self._scheduler_summary = runtime.summary()
         done = [t for t in self._trials if t.state == "done"]
+        logger.info(
+            "asha study %s: %d/%d trials done, %d epochs trained "
+            "(exhaustive: %d)", runtime._status, len(done), len(self._trials),
+            self._scheduler_summary["epochs"]["trained"],
+            self._scheduler_summary["epochs"]["exhaustive"])
+        if not done and runtime._status == "completed":
+            errs = "\n".join(t.error or "?" for t in self._trials[:3])
+            raise RuntimeError(f"all trials failed; first errors:\n{errs}")
+        return self._trials
+
+    def summary(self) -> Dict[str, Any]:
+        """Study telemetry: the scheduler's full summary (rungs, counters,
+        chip utilization, epoch savings) when scheduler='asha' ran, else
+        basic completion stats."""
+        if self._scheduler_summary is not None:
+            return self._scheduler_summary
+        by_state: Dict[str, int] = {}
+        for t in self._trials:
+            by_state[t.state] = by_state.get(t.state, 0) + 1
+        return {"study": self.name, "trials": {"total": len(self._trials),
+                                               **by_state},
+                "epochs": {"trained": sum(t.epochs_trained
+                                          for t in self._trials)}}
+
+    def get_best_trial(self) -> Trial:
+        done = [t for t in self._trials
+                if t.state == "done" and t.metric_value is not None]
         key = (min if self.metric_mode == "min" else max)
         return key(done, key=lambda t: t.metric_value)
 
     def get_best_trials(self, k: int = 1) -> List[Trial]:
-        done = sorted([t for t in self._trials if t.state == "done"],
+        done = sorted([t for t in self._trials
+                       if t.state == "done" and t.metric_value is not None],
                       key=lambda t: t.metric_value,
                       reverse=self.metric_mode == "max")
         return done[:k]

@@ -160,7 +160,8 @@ class TPUEstimator:
             steps_per_epoch: Optional[int] = None,
             shuffle: bool = True, verbose: bool = True,
             callbacks=None, profile=False,
-            max_failure_retries: Optional[int] = None
+            max_failure_retries: Optional[int] = None,
+            initial_epoch: int = 0
             ) -> List[Dict[str, float]]:
         """Train. Accepts dict-of-ndarray {'x','y'}, (x, y) tuples, XShards
         (dict or pandas shards + feature/label cols), or a data_creator
@@ -175,11 +176,32 @@ class TPUEstimator:
         ``max_failure_retries`` — when ``model_dir`` is set, a failing
         training step is retried from the latest checkpoint up to this many
         times (default 5), matching the reference's retry-from-snapshot loop
-        in InternalDistriOptimizer (Topology.scala:1256-1337)."""
+        in InternalDistriOptimizer (Topology.scala:1256-1337).
+
+        ``initial_epoch`` — offset for the shuffle-seed epoch counter, for
+        callers that split one logical training run across several fit()
+        calls (the AutoML scheduler's pause/resume): with it, epoch i of a
+        resumed run draws the same shuffle order as epoch i of an
+        uninterrupted one, keeping segmented training bit-equivalent."""
         it = learn_utils.data_to_iterator(
             data, batch_size, self.mesh, feature_cols, label_cols,
             shuffle=shuffle, config=self.config,
             stats=self._pipeline_stats)
+        if initial_epoch:
+            # BatchIterator counts shuffle epochs in `_epoch`; duck-typed
+            # pipelines (e.g. ImageNetPipeline) use `_epoch_idx`. A silent
+            # no-op here would break the pause/resume bit-equivalence the
+            # parameter exists for, so warn when neither counter exists.
+            if hasattr(it, "_epoch"):
+                it._epoch = int(initial_epoch)
+            elif hasattr(it, "_epoch_idx"):
+                it._epoch_idx = int(initial_epoch)
+            else:
+                logger.warning(
+                    "fit(initial_epoch=%d): iterator %s has no epoch "
+                    "counter to re-align; resumed epochs will not replay "
+                    "the uninterrupted run's shuffle order",
+                    initial_epoch, type(it).__name__)
         sample = next(it.epoch(shuffle=False, prefetch=False))
         self.engine.build(tuple(np.asarray(a) for a in sample.x))
         checkpoint_trigger = (Trigger.convert_trigger(checkpoint_trigger)

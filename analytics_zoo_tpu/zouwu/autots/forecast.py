@@ -25,12 +25,20 @@ class AutoTSTrainer:
     def __init__(self, dt_col: str = "datetime", target_col: str = "value",
                  horizon: int = 1, extra_features_col: Optional[List] = None,
                  search_alg=None, search_alg_params=None, scheduler=None,
-                 scheduler_params=None, name: str = "autots"):
+                 scheduler_params=None, name: str = "autots",
+                 logs_dir: Optional[str] = None):
         self.dt_col = dt_col
         self.target_col = target_col
         self.horizon = horizon
         self.extra_features_col = extra_features_col
         self.name = name
+        # scheduler="asha" routes trials through the fault-tolerant rung
+        # scheduler (pause/resume at rung boundaries, retry-with-backoff,
+        # SIGTERM study checkpointing when logs_dir is set); the reference
+        # forwarded the same kwargs to Ray Tune's scheduler slot
+        self.scheduler = scheduler
+        self.scheduler_params = scheduler_params
+        self.logs_dir = logs_dir
 
     def fit(self, train_df: pd.DataFrame,
             validation_df: Optional[pd.DataFrame] = None,
@@ -47,43 +55,65 @@ class AutoTSTrainer:
                 self.config = dict(config)
                 self.mesh = mesh
 
-            def fit_eval(self, data, validation_data, epochs, metric):
+            def fit_eval(self, data, validation_data, epochs, metric,
+                         state=None):
+                """``epochs`` is a CUMULATIVE budget and ``state`` the dict
+                from a previous call (scheduler pause/resume protocol): a
+                resumed trial keeps training its existing forecaster instead
+                of rebuilding — legacy callers (state=None) see one
+                fit-from-scratch to the full budget, as before."""
                 cfg = convert_bayes_config(self.config)
                 past = int(cfg.get("past_seq_len", 50))
-                tsft = TimeSequenceFeatureTransformer(
-                    horizon=trainer.horizon, dt_col=trainer.dt_col,
-                    target_col=trainer.target_col,
-                    extra_features_col=trainer.extra_features_col)
-                x, y = tsft.fit_transform(data, past_seq_len=past)
+                if state is not None:
+                    tsft = state["tsft"]
+                    forecaster = state["forecaster"]
+                    epochs_done = int(state.get("epochs_done", 0))
+                    x, y = tsft.transform(data, is_train=True)
+                else:
+                    tsft = TimeSequenceFeatureTransformer(
+                        horizon=trainer.horizon, dt_col=trainer.dt_col,
+                        target_col=trainer.target_col,
+                        extra_features_col=trainer.extra_features_col)
+                    x, y = tsft.fit_transform(data, past_seq_len=past)
+                    forecaster = trainer._build_forecaster(
+                        model_type, cfg, tsft.feature_num)
+                    epochs_done = 0
                 if validation_data is not None:
                     vx, vy = tsft.transform(validation_data, is_train=True)
                 else:
                     vx, vy = x, y
-                forecaster = trainer._build_forecaster(
-                    model_type, cfg, tsft.feature_num)
                 if model_type == "LSTM" and trainer.horizon == 1:
                     target_y, vtarget = y[:, 0:1], vy[:, 0:1]
                 elif model_type == "MTNet":
                     target_y, vtarget = y, vy          # (n, horizon)
                 else:
                     target_y, vtarget = y[..., None], vy[..., None]
-                forecaster.fit(x, target_y,
-                               epochs=int(getattr(recipe, "epochs", epochs)
-                                          or epochs),
-                               batch_size=int(cfg.get("batch_size", 32)))
+                if int(epochs) > epochs_done:
+                    forecaster.fit(x, target_y,
+                                   epochs=int(epochs) - epochs_done,
+                                   batch_size=int(cfg.get("batch_size", 32)))
                 pred = forecaster.predict(vx)
                 score = float(np.mean(
                     (pred.reshape(vtarget.shape) - vtarget) ** 2))
-                state = {"forecaster": forecaster, "tsft": tsft}
+                state = {"forecaster": forecaster, "tsft": tsft,
+                         "epochs_done": int(epochs)}
                 return score, {metric: score}, state
 
-        engine = TPUSearchEngine(name=self.name)
+        engine = TPUSearchEngine(name=self.name, logs_dir=self.logs_dir,
+                                 scheduler=self.scheduler,
+                                 scheduler_params=self.scheduler_params)
+        self.engine = engine
         # reference recipes' reward_metric is a tune reward (maximized
         # negative loss): reward_metric=-0.05 stops once mse <= 0.05
         reward = getattr(recipe, "reward_metric", None)
+        # the per-trial epoch budget: recipes carry it as `epochs` (LSTM) or
+        # `training_iteration` (the tune-style recipes); under
+        # scheduler="asha" this is max_t, the top-rung budget
+        max_t = int(getattr(recipe, "epochs", None)
+                    or getattr(recipe, "training_iteration", 5) or 5)
         engine.compile(train_df, lambda cfg, mesh: _TSTrialModel(cfg, mesh),
                        space, n_sampling=recipe.num_samples,
-                       epochs=getattr(recipe, "training_iteration", 5),
+                       epochs=max_t,
                        validation_data=validation_df, metric=metric,
                        metric_mode="min",
                        search_alg=getattr(recipe, "search_algorithm", None),

@@ -1,0 +1,71 @@
+"""ASHA trial scheduler walkthrough: a grid+random lr search where losing
+trials pause at rung boundaries via checkpoint and only the top 1/eta keep
+training (docs/automl_scheduler.md).
+
+Run:  python examples/automl/asha_scheduler_search.py
+Kill it with SIGTERM mid-study and run it again: the study resumes from
+logs_dir/study_state.json with every trial accounted for.
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.automl import AutoEstimator, hp
+
+
+def model_creator(config):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.relu(nn.Dense(int(config.get("hidden", 16)))(x))
+            return nn.Dense(1)(h)[:, 0]
+
+    return MLP()
+
+
+def make_data(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 8).astype(np.float32)
+    # one fixed ground-truth w for every split — train/val/test must sample
+    # the SAME function, only the inputs and noise differ
+    w = np.random.RandomState(42).randn(8).astype(np.float32)
+    y = (x @ w + 0.05 * rng.randn(n)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def main():
+    init_orca_context("local")
+    auto = AutoEstimator.from_keras(model_creator=model_creator, loss="mse",
+                                    logs_dir="/tmp/asha_example")
+    auto.fit(make_data(), epochs=9,                  # max_t: top-rung budget
+             validation_data=make_data(seed=1), metric="mse",
+             metric_mode="min", n_sampling=3,
+             search_space={"lr": hp.grid_search([0.1, 0.01, 0.001]),
+                           "hidden": hp.choice([8, 16, 32]),
+                           "batch_size": 64},
+             scheduler="asha",
+             scheduler_params={"eta": 3, "grace_period": 1,
+                               "max_trial_retries": 2})
+    s = auto.search_summary()
+    print(f"study {s['study']}: {s['status']}")
+    print(f"epochs trained {s['epochs']['trained']} "
+          f"vs exhaustive {s['epochs']['exhaustive']} "
+          f"({100 * s['epochs']['saved_frac']:.0f}% saved)")
+    for rung in s["rungs"]:
+        print(f"  rung {rung['rung']} (budget {rung['budget_epochs']} ep): "
+              f"{rung['reported']} reported, {rung['promoted']} promoted, "
+              f"best {rung['best_score']:.4f}")
+    print(f"chip utilization {s['chips']['utilization']:.2f} "
+          f"over {s['chips']['chips']} chips")
+    print("best config:", auto.get_best_config(),
+          "score:", auto.best_trial.metric_value)
+    best = auto.get_best_model()
+    res = best.evaluate(make_data(seed=2), batch_size=64, verbose=False)
+    print("best model on held-out data:", res)
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,4 +21,11 @@ timeout -k 10 "${T1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 # progress-line chars: . pass, F fail, E error, s skip, x xfail, X xpass
 echo DOTS_PASSED=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+# name the failures so a red run is triageable from the tail alone
+# (pytest -q prints "FAILED tests/..::id" / "ERROR tests/..::id" summary lines)
+fails=$(grep -aE '^(FAILED|ERROR) ' "$LOG" | awk '{print $2}' | sort -u)
+echo "DOTS_FAILED=$(printf '%s\n' "$fails" | grep -c . )"
+if [ -n "$fails" ]; then
+    printf 'DOTS_FAILED_ID=%s\n' $fails
+fi
 exit $rc

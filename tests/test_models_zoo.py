@@ -134,7 +134,11 @@ def test_seq2seq_actually_learns(orca_context):
     s2s = Seq2Seq(rnn_type="gru", nlayers=1, hidden_size=48, src_vocab=vocab,
                   tgt_vocab=vocab, embed_dim=16)
     s2s.compile(loss="sparse_categorical_crossentropy", optimizer="adam")
-    stats = s2s.fit({"x": (src, tgt_in), "y": reply}, epochs=8,
+    # 14 epochs: at 8 the loss ratio sat right on the 0.7 gate (0.711 on
+    # this host's f32-highest numerics — failing from the seed onward); the
+    # longer run restores real margin (ratio ~0.43, acc ~0.73) without
+    # weakening either gate
+    stats = s2s.fit({"x": (src, tgt_in), "y": reply}, epochs=14,
                     batch_size=128, verbose=False)
     assert stats[-1]["train_loss"] < stats[0]["train_loss"] * 0.7
     preds = np.asarray(s2s.predict((src[:256], tgt_in[:256])))

@@ -1,0 +1,610 @@
+"""TrialRuntime scheduler suite: rung math, chip leasing, pause/resume
+bit-equivalence, retry-from-checkpoint, SIGTERM study preemption + manifest
+resume, stop_score cancellation and model_state retention.
+
+Scheduler *logic* tests drive the runtime with fake in-process models (no
+XLA) so they run in milliseconds; the bit-equivalence test trains a real
+flax MLP through the extended fit_eval protocol, because that's the claim
+being tested."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.automl.scheduler.asha import AshaBracket, asha_rungs
+from analytics_zoo_tpu.automl.scheduler.lease import (DeviceLeaseManager,
+                                                      LeaseTimeout)
+from analytics_zoo_tpu.automl.scheduler.runtime import TrialRuntime
+from analytics_zoo_tpu.automl.search.search_engine import (TPUSearchEngine,
+                                                           Trial)
+
+
+# --- rung promotion math ----------------------------------------------------
+
+def test_asha_rung_geometry():
+    assert asha_rungs(9, eta=3, grace_period=1) == [1, 3, 9]
+    assert asha_rungs(8, eta=2, grace_period=1) == [1, 2, 4, 8]
+    assert asha_rungs(5, eta=3, grace_period=2) == [2, 5]
+    assert asha_rungs(1, eta=3, grace_period=1) == [1]
+    # grace > max_t clamps instead of producing an empty ladder
+    assert asha_rungs(3, eta=3, grace_period=10) == [3]
+    with pytest.raises(ValueError):
+        asha_rungs(0)
+    with pytest.raises(ValueError):
+        asha_rungs(4, eta=1)
+
+
+def test_asha_promotion_top_1_over_eta():
+    b = AshaBracket(9, eta=3, grace_period=1, metric_mode="min")
+    # fewer than eta reports: floor(n/eta) == 0, everything pauses
+    assert b.report("t0", 0, 5.0) == "pause"
+    assert b.report("t1", 0, 4.0) == "pause"
+    # third report is the best so far: top-1 of 3 -> promote
+    assert b.report("t2", 0, 3.0) == "promote"
+    # worse than the current top-1: pause
+    assert b.report("t3", 0, 9.0) == "pause"
+    # final rung never promotes/pauses: it's completion
+    assert b.report("t2", 2, 1.0) == "stop"
+
+
+def test_asha_late_promotion_and_retire():
+    b = AshaBracket(9, eta=3, grace_period=1, metric_mode="min")
+    b.report("t0", 0, 1.0)       # best, but alone -> paused
+    b.report("t1", 0, 2.0)
+    assert b.promotable() is None            # floor(2/3) == 0
+    b.report("t2", 0, 3.0)                   # n=3: top-1 is t0 -> promotable
+    assert b.promotable() == ("t0", 0)
+    assert b.promotable() is None            # already promoted
+    b.report("t3", 0, 0.5)                   # new best, immediately promoted
+    # (report returned "promote"); t3 must not reappear via promotable
+    assert b.promotable() is None
+    # at n=6 the top-2 (t3, t0) are already promoted: nothing new
+    b.report("t4", 0, 9.0)
+    b.report("t5", 0, 9.5)
+    assert b.promotable() is None
+    # at n=9 floor(9/3)=3 lifts t1 into the top set
+    b.report("t6", 0, 9.9)
+    b.report("t7", 0, 9.95)
+    b.report("t8", 0, 9.99)
+    assert b.promotable() == ("t1", 0)
+    # a retired (errored) trial is never promoted even when it qualifies
+    b2 = AshaBracket(9, eta=3, grace_period=1, metric_mode="min")
+    for i, score in enumerate([1.0, 2.0, 3.0]):
+        b2.report(f"t{i}", 0, score)
+    b2.retire("t0")
+    b2._promoted[0].clear()              # reset the inline-promotion mark
+    assert b2.promotable() is None
+
+
+def test_asha_metric_mode_max():
+    b = AshaBracket(4, eta=2, grace_period=1, metric_mode="max")
+    b.report("lo", 0, 0.1)
+    assert b.report("hi", 0, 0.9) == "promote"   # higher is better
+    assert b.promotable() is None
+
+
+# --- chip leasing -----------------------------------------------------------
+
+def test_lease_manager_never_double_books():
+    mgr = DeviceLeaseManager(devices=[f"chip{i}" for i in range(3)])
+    active = {}
+    violations = []
+    lock = threading.Lock()
+
+    def worker(n):
+        for _ in range(25):
+            with mgr.acquire(owner=n) as lease:
+                with lock:
+                    if lease.index in active:
+                        violations.append((lease.index, n,
+                                           active[lease.index]))
+                    active[lease.index] = n
+                time.sleep(0.001)
+                with lock:
+                    del active[lease.index]
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not violations, f"chip double-booked: {violations[:3]}"
+    util = mgr.utilization()
+    assert sum(util["leases"]) == 8 * 25
+    assert not mgr.outstanding()
+
+
+def test_lease_timeout_and_double_release():
+    mgr = DeviceLeaseManager(devices=["only"])
+    lease = mgr.acquire(owner="a")
+    with pytest.raises(LeaseTimeout):
+        mgr.acquire(owner="b", timeout=0.05)
+    lease.release()
+    lease.release()                      # idempotent
+    lease2 = mgr.acquire(owner="b", timeout=0.05)
+    lease2.release()
+
+
+# --- fake models for runtime-logic tests ------------------------------------
+
+class _FakeModel:
+    """lr-indexed quadratic 'loss' that improves with epochs; supports the
+    full extended protocol in-process (no XLA)."""
+
+    def __init__(self, config, mesh):
+        self.config = config
+
+    def fit_eval(self, data, validation_data, epochs, metric, state=None,
+                 trial_context=None):
+        done = 0 if state is None else int(state["epochs_done"])
+        total = int(epochs)
+        if trial_context is not None:
+            trial_context.set_state_fn(lambda: {"epochs_done": done})
+            while done < total:
+                done += 1
+                if trial_context.should_report(done):
+                    trial_context.report(done, self._score(done))
+        else:
+            done = total
+        return self._score(done), {metric: self._score(done)}, \
+            {"epochs_done": done}
+
+    def _score(self, done):
+        return 1.0 / max(done, 1) + float(self.config["lr"])
+
+
+def _fake_trials(n=9, **extra):
+    return [Trial(i, {"lr": 0.01 * i, **extra}) for i in range(n)]
+
+
+def _runtime(trials, model_cls=_FakeModel, **kw):
+    kw.setdefault("metric", "mse")
+    kw.setdefault("metric_mode", "min")
+    kw.setdefault("max_t", 9)
+    kw.setdefault("eta", 3)
+    kw.setdefault("grace_period", 1)
+    kw.setdefault("retry_backoff_s", 0.01)
+    return TrialRuntime(trials, model_cls, data=None, **kw)
+
+
+# --- scheduler behavior (fake models) ---------------------------------------
+
+def test_runtime_spends_fewer_epochs_and_finds_best():
+    trials = _fake_trials(9)
+    rt = _runtime(trials)
+    rt.run()
+    s = rt.summary()
+    assert s["status"] == "completed"
+    assert all(t.state == "done" for t in trials)
+    # the lr=0 trial is best at every fidelity: it must train to max_t and win
+    best = min(trials, key=lambda t: t.metric_value)
+    assert best.config["lr"] == 0.0
+    assert best.epochs_trained == 9
+    # massive pruning vs the exhaustive 9*9 budget
+    assert s["epochs"]["trained"] < s["epochs"]["exhaustive"] * 0.5
+    # rung populations shrink ~1/eta per rung
+    reported = [r["reported"] for r in s["rungs"]]
+    assert reported[0] == 9 and reported[-1] >= 1
+    assert reported[0] > reported[1] >= reported[2]
+    # pruned trials surface their checkpointed state at finalize (a pruned
+    # trial can win get_best_trial on a noisy metric; get_best_model needs
+    # its weights) — with no retention callback, every trial keeps one
+    assert all(t.model_state is not None for t in trials)
+
+
+def test_runtime_small_study_force_promotes_one_winner():
+    # 2 trials < eta=3: pure ASHA would pause both forever; the runtime's
+    # small-study guard must still deliver one max_t-trained winner
+    trials = _fake_trials(2)
+    rt = _runtime(trials)
+    rt.run()
+    assert any(t.epochs_trained == 9 for t in trials)
+    assert rt.summary()["counters"]["forced_promotions"] >= 1
+
+
+def test_runtime_retries_transient_failure_from_checkpoint():
+    boom = {"left": 2}
+
+    class Flaky(_FakeModel):
+        def fit_eval(self, *a, **kw):
+            if self.config["lr"] == 0.0 and boom["left"] > 0:
+                boom["left"] -= 1
+                raise RuntimeError("injected transient failure")
+            return super().fit_eval(*a, **kw)
+
+    trials = _fake_trials(4)
+    rt = _runtime(trials, model_cls=Flaky, max_t=4, eta=2,
+                  max_trial_retries=3)
+    rt.run()
+    t0 = trials[0]
+    assert t0.state == "done" and t0.retries == 2
+    assert rt.summary()["counters"]["retries"] == 2
+
+
+def test_runtime_exhausted_retries_mark_error_others_unaffected():
+    class AlwaysBoom(_FakeModel):
+        def fit_eval(self, *a, **kw):
+            if self.config["lr"] == 0.0:
+                raise RuntimeError("hard failure")
+            return super().fit_eval(*a, **kw)
+
+    trials = _fake_trials(4)
+    rt = _runtime(trials, model_cls=AlwaysBoom, max_t=4, eta=2,
+                  max_trial_retries=1)
+    rt.run()
+    assert trials[0].state == "error"
+    assert trials[0].retries == 2            # initial + 1 retry
+    assert "hard failure" in trials[0].error
+    assert all(t.state == "done" for t in trials[1:])
+
+
+def test_runtime_legacy_fit_eval_is_driven_per_rung():
+    calls = []
+
+    class Legacy:
+        def __init__(self, config, mesh):
+            self.config = config
+
+        def fit_eval(self, data, validation_data, epochs, metric):
+            calls.append((self.config["lr"], int(epochs)))
+            s = 1.0 / int(epochs) + self.config["lr"]
+            return s, {metric: s}, {"w": "weights"}
+
+    trials = _fake_trials(4)
+    rt = _runtime(trials, model_cls=Legacy, max_t=4, eta=2)
+    rt.run()
+    assert all(t.state == "done" for t in trials)
+    # rung ladder [1, 2, 4]: the winner was re-driven at growing cumulative
+    # budgets; pruned trials only ever saw the small ones
+    budgets = sorted({b for _, b in calls})
+    assert budgets[0] == 1 and budgets[-1] == 4
+    winner = min(trials, key=lambda t: t.metric_value)
+    assert winner.metric_value == pytest.approx(0.25 + winner.config["lr"])
+
+
+def test_runtime_sigterm_checkpoints_and_manifest_resumes(tmp_path):
+    logs = str(tmp_path / "study")
+
+    class Slow(_FakeModel):
+        def fit_eval(self, data, validation_data, epochs, metric, state=None,
+                     trial_context=None):
+            done = 0 if state is None else int(state["epochs_done"])
+            total = int(epochs)
+            trial_context.set_state_fn(lambda: {"epochs_done": done})
+            while done < total:
+                time.sleep(0.05)                 # one "epoch"
+                done += 1
+                trial_context.heartbeat(done)    # preemption safe-point
+                if trial_context.should_report(done):
+                    trial_context.report(done, self._score(done))
+            return self._score(done), {metric: self._score(done)}, \
+                {"epochs_done": done}
+
+    trials = _fake_trials(6)
+    rt = _runtime(trials, model_cls=Slow, max_t=8, eta=2, max_concurrent=2,
+                  logs_dir=logs)
+    # deliver a real SIGTERM mid-study; the watcher latches it in the main
+    # thread while workers are mid-epoch
+    timer = threading.Timer(
+        0.4, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        rt.run()
+    finally:
+        timer.cancel()
+    s = rt.summary()
+    assert s["status"] == "preempted"
+    manifest = json.load(open(os.path.join(logs, "study_state.json")))
+    assert manifest["status"] == "preempted"
+    assert {t["id"] for t in manifest["trials"]} == set(range(6))
+    # at least one running trial was checkpointed mid-flight
+    paused = [t for t in manifest["trials"] if t["status"] == "paused"]
+    assert paused, manifest["trials"]
+    assert all(t["epochs_done"] > 0 for t in paused)
+
+    # resume the study from the manifest with fresh objects
+    trials2 = _fake_trials(6)
+    rt2 = _runtime(trials2, model_cls=Slow, max_t=8, eta=2,
+                   max_concurrent=2, logs_dir=logs)
+    rt2.run(resume="auto")
+    s2 = rt2.summary()
+    assert s2["status"] == "completed"
+    # every trial accounted for: done (full or pruned) with a real score
+    assert all(t.state == "done" and t.metric_value is not None
+               for t in trials2)
+    assert any(t.epochs_trained + _done_before(manifest, t.trial_id) >= 8
+               for t in trials2)
+    best = min(trials2, key=lambda t: t.metric_value)
+    assert best.config["lr"] == 0.0
+
+
+def _done_before(manifest, tid):
+    for t in manifest["trials"]:
+        if t["id"] == tid:
+            return t["epochs_done"]
+    return 0
+
+
+class _StateOnlyModel:
+    """State-in/state-out but no trial_context (the zouwu _TSTrialModel
+    shape): the runtime drives it rung-by-rung via _drive_rungs."""
+
+    def __init__(self, config, mesh):
+        self.config = config
+
+    def fit_eval(self, data, validation_data, epochs, metric, state=None):
+        done = 0 if state is None else int(state["epochs_done"])
+        s = 1.0 / max(int(epochs), 1) + float(self.config["lr"])
+        return s, {metric: s}, {"epochs_done": int(epochs), "trained_from": done}
+
+
+def test_runtime_epoch_accounting_exact_on_rung_driven_path():
+    # single trial, rungs [1, 2, 4]: slices train 1, +1, +2 epochs via
+    # forced promotions -> exactly 4 epochs spent. The pause handler used
+    # to re-account each segment on top of _drive_rungs' own accounting
+    # (doubling to 6+) — the bug that inflated every AutoTS asha summary.
+    trials = _fake_trials(1)
+    rt = _runtime(trials, model_cls=_StateOnlyModel, max_t=4, eta=2)
+    rt.run()
+    s = rt.summary()
+    assert trials[0].state == "done"
+    assert s["epochs"]["trained"] == 4
+    assert trials[0].epochs_trained == 4
+
+
+def test_runtime_resumes_trials_stranded_as_running(tmp_path):
+    # a kill -9 mid-slice snapshots the trial as "running" in the manifest;
+    # resume must re-queue it, not strand it
+    logs = str(tmp_path / "crash")
+    trials = _fake_trials(4)
+    rt = _runtime(trials, max_t=4, eta=2, logs_dir=logs)
+    rt.run()
+    path = os.path.join(logs, "study_state.json")
+    doc = json.load(open(path))
+    doc["status"] = "preempted"
+    victim = doc["trials"][0]
+    victim.update(status="running", score=None, epochs_done=1)
+    json.dump(doc, open(path, "w"))
+
+    trials2 = _fake_trials(4)
+    rt2 = _runtime(trials2, max_t=4, eta=2, logs_dir=logs)
+    rt2.run(resume=True)
+    assert rt2.summary()["status"] == "completed"
+    assert trials2[0].state == "done"
+    assert trials2[0].metric_value is not None
+
+
+def test_runtime_halt_does_not_burn_retries():
+    # a transient failure landing while the study halts must park the trial
+    # runnable (retried on resume), not convert it to a permanent error
+    trials = _fake_trials(2)
+    rt = _runtime(trials, max_t=4, eta=2, max_trial_retries=2)
+    rt._halt_study("preempted")
+    rec = rt._rec[trials[0].trial_id]
+    outcome = {"trial": trials[0], "kind": "failed",
+               "exc": RuntimeError("transient"), "tb": "tb",
+               "checkpoint": None}
+    assert rt._finish_trial(outcome) is None
+    assert rec["status"] == "paused" and rec["runnable"]
+    assert trials[0].state == "paused"
+    # the deferred failure does NOT consume the retry budget: the resumed
+    # study owes the trial a live retry-with-backoff
+    assert rec["retries"] == 0
+
+
+def test_runtime_completed_study_is_not_readopted(tmp_path):
+    logs = str(tmp_path / "study2")
+    trials = _fake_trials(4)
+    rt = _runtime(trials, max_t=4, eta=2, logs_dir=logs)
+    rt.run()
+    assert rt.summary()["status"] == "completed"
+    # re-running the same (completed) study with resume="auto" starts fresh
+    trials2 = _fake_trials(4)
+    rt2 = _runtime(trials2, max_t=4, eta=2, logs_dir=logs)
+    rt2.run(resume="auto")
+    assert rt2.summary()["epochs"]["trained"] > 0
+
+
+def test_runtime_stop_score_halts_study():
+    trials = _fake_trials(8)
+    # lr=0 reaches 1/4 + 0 = 0.25 at max_t; threshold 0.3 triggers the halt
+    rt = _runtime(trials, max_t=4, eta=2, stop_score=0.3)
+    rt.run()
+    s = rt.summary()
+    assert s["status"] == "stopped"
+    assert any(t.state == "done" and t.metric_value <= 0.3 for t in trials)
+
+
+def test_runtime_events_jsonl_written(tmp_path):
+    logs = str(tmp_path / "ev")
+    trials = _fake_trials(4)
+    rt = _runtime(trials, max_t=4, eta=2, logs_dir=logs)
+    rt.run()
+    lines = [json.loads(l) for l in
+             open(os.path.join(logs, "study_events.jsonl"))]
+    kinds = {l["event"] for l in lines}
+    assert {"study_start", "trial_start", "report",
+            "study_completed"} <= kinds
+    assert any(k in kinds for k in ("pause", "promote"))
+
+
+# --- engine satellites ------------------------------------------------------
+
+class _InstantModel:
+    def __init__(self, config, mesh):
+        self.config = config
+
+    def fit_eval(self, data, validation_data, epochs, metric):
+        s = float(self.config["lr"])
+        return s, {metric: s}, {"weights": np.zeros(4)}
+
+
+def test_engine_stop_score_cancels_concurrent_pending():
+    eng = TPUSearchEngine(max_concurrent=2, name="stopper")
+    eng.compile(None, _InstantModel, {"lr": 0.0}, n_sampling=24,
+                epochs=1, metric="mse", metric_mode="min", stop_score=0.5)
+    eng.run()
+    states = [t.state for t in eng._trials]
+    # the threshold is reached by the very first completion: the engine must
+    # cancel (not run) a chunk of the 24 queued trials
+    assert states.count("cancelled") > 0
+    assert states.count("done") >= 1
+    assert eng.get_best_trial().metric_value == 0.0
+
+
+def test_engine_model_state_topk_retention():
+    class Scored(_InstantModel):
+        def fit_eval(self, data, validation_data, epochs, metric):
+            s = float(self.config["lr"])
+            return s, {metric: s}, {"weights": np.zeros(8), "score": s}
+
+    eng = TPUSearchEngine(max_concurrent=2, name="retain",
+                          keep_model_states=2)
+    eng.compile(None, Scored, {"lr": 0.0}, n_sampling=6, epochs=1,
+                metric="mse", metric_mode="min")
+    # distinct scores so top-k is unambiguous
+    for i, t in enumerate(eng._trials):
+        t.config = {"lr": float(i)}
+    eng.run()
+    kept = [t for t in eng._trials if t.model_state is not None]
+    assert len(kept) == 2
+    assert sorted(t.metric_value for t in kept) == [0.0, 1.0]
+    # keep_model_states=None keeps everything (legacy behavior)
+    eng2 = TPUSearchEngine(max_concurrent=2, name="keepall",
+                           keep_model_states=None)
+    eng2.compile(None, Scored, {"lr": 0.0}, n_sampling=3, epochs=1,
+                 metric="mse", metric_mode="min")
+    eng2.run()
+    assert all(t.model_state is not None for t in eng2._trials)
+
+
+def test_engine_rejects_unknown_scheduler():
+    eng = TPUSearchEngine()
+    with pytest.raises(ValueError, match="scheduler"):
+        eng.compile(None, _InstantModel, {}, scheduler="pbt")
+    with pytest.raises(ValueError, match="exclusive"):
+        TPUSearchEngine(scheduler="asha").compile(
+            None, _InstantModel, {}, search_alg="bayes")
+
+
+def test_engine_asha_with_fake_models():
+    eng = TPUSearchEngine(name="asha_fake", scheduler="asha",
+                          scheduler_params={"eta": 3, "grace_period": 1})
+
+    class Fake(_FakeModel):
+        pass
+
+    eng.compile(None, Fake, {"lr": 0.0}, n_sampling=9, epochs=9,
+                metric="mse", metric_mode="min")
+    for i, t in enumerate(eng._trials):
+        t.config = {"lr": 0.01 * i}
+    eng.run()
+    s = eng.summary()
+    assert s["epochs"]["trained"] < s["epochs"]["exhaustive"]
+    assert s["chips"]["utilization"] >= 0
+    assert eng.get_best_trial().config["lr"] == 0.0
+
+
+# --- pause/resume bit-equivalence on a real model ---------------------------
+
+def _mlp_builder():
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.automl.model_builder import ModelBuilder
+
+    def model_creator(config):
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.relu(nn.Dense(config.get("hidden", 4))(x))
+                return nn.Dense(1)(h)[:, 0]
+        return MLP()
+
+    return ModelBuilder(model_creator, loss_creator=lambda c: "mse")
+
+
+def _mlp_data(n=64, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.rand(n, 4).astype(np.float32)
+    y = (x @ np.array([1., -2., 3., .5], np.float32) + .1).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def test_pause_resume_bit_equivalence(orca_context):
+    """A trial paused at a rung and resumed from its (pickled) checkpoint
+    must produce bit-identical weights to one trained straight through:
+    the engine step counter (dropout rng) rides in the state and
+    fit(initial_epoch=...) re-aligns the shuffle-seed epoch counter."""
+    import pickle
+
+    import jax
+    from jax.sharding import Mesh
+
+    builder = _mlp_builder()
+    data = _mlp_data()
+    # steps_per_dispatch pinned: the claim under test is the scheduler's
+    # seed/step/shuffle alignment, not fuse-probe invariance (covered by
+    # the data-pipeline suite) — and skipping the three timing probes
+    # keeps the test fast and deterministic
+    cfg = {"lr": 0.05, "hidden": 4, "batch_size": 32,
+           "steps_per_dispatch": 1}
+    dev = jax.local_devices()[0]
+    mesh = Mesh(np.asarray([dev]).reshape(1, 1, 1, 1),
+                ("dp", "fsdp", "tp", "sp"))
+
+    straight = builder(cfg, mesh)
+    s1, _, state1 = straight.fit_eval(data, None, epochs=4, metric="mse")
+
+    part1 = builder(cfg, mesh)
+    _, _, ckpt = part1.fit_eval(data, None, epochs=2, metric="mse")
+    ckpt = pickle.loads(pickle.dumps(ckpt))      # disk round-trip
+    part2 = builder(cfg, mesh)                   # fresh model, fresh engine
+    s2, _, state2 = part2.fit_eval(data, None, epochs=4, metric="mse",
+                                   state=ckpt)
+
+    assert s1 == s2
+    assert state1["step"] == state2["step"]
+    for a, b in zip(jax.tree.leaves(state1["params"]),
+                    jax.tree.leaves(state2["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_auto_estimator_asha_end_to_end(orca_context):
+    """Acceptance: scheduler='asha' on a real search space spends fewer
+    total training epochs than the exhaustive path while get_best_trial
+    matches within tolerance."""
+    from analytics_zoo_tpu.automl import AutoEstimator, hp
+
+    def fit_once(scheduler):
+        auto = AutoEstimator.from_keras(
+            model_creator=_mlp_builder().model_creator, loss="mse")
+        # space chosen separable at rung fidelity: the two workable lrs
+        # track each other at every budget (so whichever the async race
+        # promotes, final quality is near-identical at 0.14 vs 0.18 mse)
+        # while the hopeless one is pruned at the first rung (2.87 mse)
+        auto.fit(_mlp_data(n=128), epochs=8,
+                 validation_data=_mlp_data(n=128, seed=1),
+                 metric="mse", metric_mode="min", n_sampling=1,
+                 search_space={"lr": hp.grid_search([0.2, 0.18, 1e-5]),
+                               "hidden": 4, "batch_size": 32},
+                 scheduler=scheduler,
+                 scheduler_params={"eta": 2, "grace_period": 2})
+        return auto
+
+    asha = fit_once("asha")
+    full = fit_once(None)
+    s = asha.search_summary()
+    assert s["epochs"]["trained"] < s["epochs"]["exhaustive"]
+    # delivered quality matches the exhaustive search within tolerance
+    # (config identity is not guaranteed — which of the two near-equal lrs
+    # wins depends on report arrival order, the ASHA approximation — but
+    # either one scores within 1.25x of the other at the full budget)
+    assert asha.best_trial.metric_value <= full.best_trial.metric_value * 1.5
+    assert asha.best_trial.config["lr"] > 1e-3    # hopeless lr never wins
+    assert asha.best_trial.epochs_trained == 8    # winner got the full budget
