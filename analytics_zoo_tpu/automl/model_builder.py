@@ -24,15 +24,18 @@ class ModelBuilder:
         self.loss_creator = loss_creator
         self.metric_extra = metric_extra or []
 
-    def __call__(self, config: Dict, mesh) -> "TrialModel":
-        return TrialModel(self, config, mesh)
+    def __call__(self, config: Dict, mesh,
+                 compile_cache=None) -> "TrialModel":
+        return TrialModel(self, config, mesh, compile_cache=compile_cache)
 
 
 class TrialModel:
-    def __init__(self, builder: ModelBuilder, config: Dict, mesh):
+    def __init__(self, builder: ModelBuilder, config: Dict, mesh,
+                 compile_cache=None):
         self.builder = builder
         self.config = dict(config)
         self.mesh = mesh
+        self.compile_cache = compile_cache
         self.estimator = None
 
     def _build_estimator(self, metric: str):
@@ -72,12 +75,17 @@ class TrialModel:
             maybe = self.builder.optimizer_creator(model, self.config)
             optimizer = convert_torch_optimizer(maybe) or maybe
         elif "lr" in self.config:
-            import optax
-            optimizer = optax.adam(self.config["lr"])
+            # hyperparameters-as-arguments: the Adam wrapper routes a
+            # scalar lr through optax.inject_hyperparams, so trials that
+            # differ only in lr lower to the SAME program and an entire
+            # ASHA rung shares ONE train-step executable (instead of
+            # baking config["lr"] into optax.adam and compiling per trial)
+            from ..orca.learn.optimizers import Adam
+            optimizer = Adam(lr=float(self.config["lr"]))
         metrics = [metric] if metric not in ("loss",) else None
         est = TPUEstimator(model, loss=loss, optimizer=optimizer,
                            metrics=metrics, config=self.config,
-                           mesh=self.mesh)
+                           mesh=self.mesh, compile_cache=self.compile_cache)
         self._param_loader = param_loader
         return est
 

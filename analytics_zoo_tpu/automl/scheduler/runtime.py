@@ -176,9 +176,21 @@ class TrialRuntime:
                  logs_dir: Optional[str] = None, name: str = "study",
                  stop_score: Optional[float] = None,
                  devices: Optional[List] = None,
-                 on_trial_done: Optional[Callable] = None):
+                 on_trial_done: Optional[Callable] = None,
+                 compile_cache=None):
+        from ...compile import resolve_cache
         self.trials = trials
         self.model_builder = model_builder
+        # the host-level executable cache every trial compiles through:
+        # with hyperparams-as-arguments an entire rung of scalar-hyperparam
+        # trials shares ONE train-step executable. compile/cache_hit events
+        # are tailed into the study's JSONL event log while run() is live.
+        self.compile_cache = resolve_cache(compile_cache)
+        try:
+            self._builder_takes_cache = "compile_cache" in \
+                inspect.signature(model_builder).parameters
+        except (TypeError, ValueError):
+            self._builder_takes_cache = False
         self.data = data
         self.validation_data = validation_data
         self.metric = metric
@@ -209,6 +221,10 @@ class TrialRuntime:
             for t in trials}
         self._counters = {"late_promotions": 0, "forced_promotions": 0,
                           "retries": 0, "preempted_slices": 0}
+        # baseline for per-study compile attribution: the cache may be
+        # process-wide, so summary() reports the delta since run() started
+        self._compile_base = (self.compile_cache.stats.snapshot()
+                              if self.compile_cache is not None else {})
         self._wall_s = 0.0
         self._status = "created"
 
@@ -405,7 +421,7 @@ class TrialRuntime:
                 "trial_start" if start_done == 0 else "trial_resume",
                 trial=trial.trial_id, chip=lease.index,
                 epochs_done=start_done)
-            model = self.model_builder(trial.config, lease.mesh)
+            model = self._build_model(trial.config, lease.mesh)
             caps = _fit_eval_caps(model.fit_eval)
             state_in = self._load_state(trial.trial_id) if start_done else None
             if caps["state"] is False and state_in is not None:
@@ -449,6 +465,16 @@ class TrialRuntime:
                      "end_epochs": ctx.epochs_done, "kind":
                      outcome.get("kind", "?"), "duration_s": round(dt, 3)})
         return outcome
+
+    def _build_model(self, config, mesh):
+        """Hand the host-level compile cache to builders that accept it
+        (signature-detected like the fit_eval protocol extensions, so
+        legacy builders keep working unchanged — they still share through
+        the process-wide cache by default)."""
+        if self.compile_cache is not None and self._builder_takes_cache:
+            return self.model_builder(config, mesh,
+                                      compile_cache=self.compile_cache)
+        return self.model_builder(config, mesh)
 
     def _account(self, rec, spent: int, epochs_done: int):
         with self._lock:
@@ -576,9 +602,9 @@ class TrialRuntime:
 
     # --- main loop ----------------------------------------------------------
     def run(self, resume="auto") -> List:
-        from ...orca.learn.preemption import PreemptionWatcher
-
         t_start = time.perf_counter()
+        if self.compile_cache is not None:
+            self._compile_base = self.compile_cache.stats.snapshot()
         adopted = self._try_adopt_manifest(resume)
         self._status = "running"
         self._ev.emit("study_start", name=self.name, trials=len(self.trials),
@@ -593,6 +619,27 @@ class TrialRuntime:
             if rec["status"] == "pending" or (rec["status"] == "paused"
                                               and rec["runnable"]):
                 queue.append(trial)
+        # tail compile-plane events (compile / cache_hit / disk_hit) into
+        # the study's JSONL log for the duration of the run, so a study
+        # trace shows exactly which trial slices paid compilation
+        unsub_compile = (self.compile_cache.add_listener(
+            lambda ev: self._ev.emit(ev.pop("event"), **ev))
+            if self.compile_cache is not None else None)
+        try:
+            self._run_pool(queue, delayed, seq)
+        finally:
+            if unsub_compile is not None:
+                unsub_compile()
+        self._finalize()
+        self._wall_s = time.perf_counter() - t_start
+        self._save_manifest(self._status)
+        self._ev.emit("study_" + self._status, name=self.name,
+                      wall_s=round(self._wall_s, 3))
+        return self.trials
+
+    def _run_pool(self, queue: deque, delayed: List, seq: int):
+        from ...orca.learn.preemption import PreemptionWatcher
+
         with PreemptionWatcher() as watcher, \
                 ThreadPoolExecutor(max_workers=self.workers,
                                    thread_name_prefix="trial") as pool:
@@ -648,12 +695,6 @@ class TrialRuntime:
                         heapq.heappush(
                             delayed, (time.monotonic() + backoff, seq, trial))
                     self._save_manifest("running")
-        self._finalize()
-        self._wall_s = time.perf_counter() - t_start
-        self._save_manifest(self._status)
-        self._ev.emit("study_" + self._status, name=self.name,
-                      wall_s=round(self._wall_s, 3))
-        return self.trials
 
     def _trial_by_id(self, tid):
         for t in self.trials:
@@ -751,7 +792,11 @@ class TrialRuntime:
                      "duration_s": round(trial.duration_s, 3),
                      "slices": list(rec["slices"])})
         exhaustive = len(self.trials) * self.max_t
+        compile_snap = (
+            self.compile_cache.stats.delta_since(self._compile_base)
+            if self.compile_cache is not None else {})
         return {"study": self.name, "status": self._status,
+                "compile": compile_snap,
                 "wall_s": round(self._wall_s, 3),
                 "max_t": self.max_t, "eta": self.bracket.eta,
                 "rungs": self.bracket.snapshot(),

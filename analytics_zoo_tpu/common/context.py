@@ -91,6 +91,7 @@ def init_orca_context(cluster_mode: str = "local",
                       num_processes: Optional[int] = None,
                       process_id: Optional[int] = None,
                       config: Optional[OrcaConfig] = None,
+                      compile_cache_dir: Optional[str] = None,
                       **extra) -> ClusterContext:
     """Bootstrap the cluster context. API-compatible entry point with the
     reference's ``init_orca_context`` (pyzoo/zoo/orca/common.py:148), with
@@ -105,8 +106,18 @@ def init_orca_context(cluster_mode: str = "local",
 
     ``cores``/``memory``/``num_nodes`` are accepted for source compatibility
     with Spark-era callers; on TPU they do not allocate anything.
+
+    ``compile_cache_dir`` (or env ``ZOO_COMPILE_CACHE``) points the
+    compile plane's executable cache at a persistent directory: engines,
+    serving workers and AutoML studies serialize their AOT executables
+    there (plus JAX's own ``jax_compilation_cache_dir`` under ``<dir>/
+    xla``), so warm restarts skip XLA compilation entirely.
     """
     global _current
+    cache_dir = compile_cache_dir or os.environ.get("ZOO_COMPILE_CACHE")
+    if cache_dir:
+        from ..compile import configure_compile_cache
+        configure_compile_cache(cache_dir)
     with _lock:
         if _current is not None and not _current._stopped:
             logger.warning("init_orca_context called twice; returning existing "

@@ -64,7 +64,13 @@ class TrainEngine:
     def __init__(self, module, tx: optax.GradientTransformation,
                  loss_fn: Optional[Callable], metrics: Dict[str, Metric],
                  mesh: Mesh, seed: int = 0,
-                 fsdp_params: bool = False):
+                 fsdp_params: bool = False, compile_cache=None):
+        from ...compile import resolve_cache
+        # every jitted step goes through the process-wide compile plane
+        # (ExecutableCache): structurally identical engines share ONE XLA
+        # executable instead of each paying compilation. ``compile_cache``
+        # False opts this engine out (plain jax.jit).
+        self.compile_cache = resolve_cache(compile_cache)
         self.module = module
         self.tx = tx
         self.loss_fn = loss_fn
@@ -361,8 +367,9 @@ class TrainEngine:
         """Fused-eval entry: batch carries stacked (k, local_batch, ...)
         arrays. Returns (states, summed_loss, summed_count)."""
         if self._jit_eval_multi is None:
-            self._jit_eval_multi = jax.jit(self._eval_multi_step,
-                                           donate_argnums=(2,))
+            self._jit_eval_multi = self._wrap("eval_multi",
+                                              self._eval_multi_step,
+                                              donate_argnums=(2,))
         t0 = time.perf_counter()
         out = self._jit_eval_multi(self.params, self.extra_vars,
                                    metric_states, batch.x, batch.y,
@@ -377,13 +384,45 @@ class TrainEngine:
         return preds
 
     # --- public API ---------------------------------------------------------
+    def _wrap(self, label: str, fn, donate_argnums=()):
+        """jit through the compile plane when enabled, plain jax.jit
+        otherwise. Both return jit-like callables (with ``.lower``)."""
+        if self.compile_cache is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return self.compile_cache.wrap(fn, label=label,
+                                       donate_argnums=donate_argnums)
+
     def ensure_jit_train(self):
         """Build (or return) the jitted single-step executable — the one
         place its jit options live, shared by train_batch and the
         estimator's fuse probe."""
         if self._jit_train is None:
-            self._jit_train = jax.jit(self._train_step, donate_argnums=(0, 2))
+            self._jit_train = self._wrap("train", self._train_step,
+                                         donate_argnums=(0, 2))
         return self._jit_train
+
+    def train_step_cache_key(self, batch: Batch) -> Optional[str]:
+        """Structural key of the single-step train executable for this
+        engine + batch signature (lowering only, no compile; the lowering
+        is reused by the next dispatch). None when the compile plane is
+        off. Stable across warm restarts, so it also keys the estimator's
+        persisted fuse-probe results."""
+        fn = self.ensure_jit_train()
+        if not hasattr(fn, "cache_key"):
+            return None
+        return fn.cache_key(self.params, self.extra_vars, self.opt_state,
+                            jnp.asarray(self.step), batch.x, batch.y,
+                            batch.w)
+
+    def eval_step_cache_key(self, metric_states, batch: Batch
+                            ) -> Optional[str]:
+        """Structural key of the single-step eval executable (see
+        train_step_cache_key)."""
+        fn = self._ensure_jit_eval()
+        if not hasattr(fn, "cache_key"):
+            return None
+        return fn.cache_key(self.params, self.extra_vars, metric_states,
+                            batch.x, batch.y, batch.w)
 
     def train_batch(self, batch: Batch) -> jnp.ndarray:
         self.ensure_jit_train()
@@ -401,8 +440,9 @@ class TrainEngine:
         arrays — every x/y leaf is ``(k, local_batch, ...)`` and w (if any) is
         ``(k, local_batch)``. Returns the per-step losses ``(k,)``."""
         if self._jit_train_multi is None:
-            self._jit_train_multi = jax.jit(self._train_multi_step,
-                                            donate_argnums=(0, 2))
+            self._jit_train_multi = self._wrap("train_multi",
+                                               self._train_multi_step,
+                                               donate_argnums=(0, 2))
         t0 = time.perf_counter()
         self.params, self.extra_vars, self.opt_state, losses = \
             self._jit_train_multi(
@@ -421,11 +461,16 @@ class TrainEngine:
                                                   m.init_state()))
                 for name, m in self.metrics.items()}
 
-    def eval_batch(self, metric_states, batch: Batch):
+    def _ensure_jit_eval(self):
         if self._jit_eval is None:
             # metric states are consumed and replaced every batch — donate
             # them so XLA updates in place instead of reallocating
-            self._jit_eval = jax.jit(self._eval_step, donate_argnums=(2,))
+            self._jit_eval = self._wrap("eval", self._eval_step,
+                                        donate_argnums=(2,))
+        return self._jit_eval
+
+    def eval_batch(self, metric_states, batch: Batch):
+        self._ensure_jit_eval()
         t0 = time.perf_counter()
         out = self._jit_eval(self.params, self.extra_vars, metric_states,
                              batch.x, batch.y, batch.w)
@@ -443,7 +488,7 @@ class TrainEngine:
 
     def predict_batch(self, x) -> np.ndarray:
         if self._jit_predict is None:
-            self._jit_predict = jax.jit(self._predict_step)
+            self._jit_predict = self._wrap("predict", self._predict_step)
         return self._jit_predict(self.params, self.extra_vars, x)
 
     # --- device-side state snapshot (probe/rollback support) ----------------

@@ -71,7 +71,7 @@ class TPUEstimator:
     def __init__(self, module, loss=None, optimizer="adam", metrics=None,
                  model_dir: Optional[str] = None,
                  config: Optional[dict] = None, seed: int = 0, mesh=None,
-                 fsdp: bool = False):
+                 fsdp: bool = False, compile_cache=None):
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.module = module
@@ -80,8 +80,13 @@ class TPUEstimator:
         self.loss_fn = convert_loss(loss) if loss is not None else None
         self.metrics = convert_metrics_list(metrics)
         tx = convert_optimizer(optimizer)
+        # compile plane: default is the process-wide executable cache;
+        # ``compile_cache=False`` (arg or config key) opts out to plain jit
+        if compile_cache is None:
+            compile_cache = self.config.get("compile_cache", None)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
-                                  self.mesh, seed=seed, fsdp_params=fsdp)
+                                  self.mesh, seed=seed, fsdp_params=fsdp,
+                                  compile_cache=compile_cache)
         # one stats object spans iterator assembly, the pump's H2D stage and
         # the engine's dispatches — the estimator is where they all meet
         from ...native.infeed import PipelineStats
@@ -106,6 +111,11 @@ class TPUEstimator:
         history. Every future perf PR should look here first to see where
         epoch time goes."""
         snap = self._pipeline_stats.snapshot()
+        if self.engine.compile_cache is not None:
+            # compile-plane counters ride along: compiles vs cache hits and
+            # (estimated) compile seconds saved, cumulative for the cache
+            # this engine compiles through (shared process-wide by default)
+            snap["compile"] = self.engine.compile_cache.stats.snapshot()
         if reset:
             self._pipeline_stats.reset()
         return snap
@@ -284,7 +294,7 @@ class TPUEstimator:
                 for a in tuple(it.x) + tuple(it.y or ()))
             k = self._fuse_probe_cache.get(key)
             if k is None:
-                k = self._auto_probe_fuse(it, batch_bytes)
+                k = self._auto_probe_fuse(it, batch_bytes, probe_key=key)
                 self._fuse_probe_cache[key] = k
         return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch,
                                      trigger)
@@ -322,32 +332,55 @@ class TPUEstimator:
             k = min(k, cap)
         return max(1, min(k, steps))
 
-    def _auto_probe_fuse(self, it, batch_bytes: int) -> int:
+    def _probe_aux_key(self, step_key: Optional[str], probe_key
+                       ) -> Optional[str]:
+        """Disk key for a persisted fuse-probe result: the engine step's
+        structural executable key (compile-plane fingerprint — model tree,
+        avals, mesh, optimizer structure) + the probe's input signature."""
+        if step_key is None or probe_key is None:
+            return None
+        return step_key + "/" + repr(probe_key)
+
+    def _auto_probe_fuse(self, it, batch_bytes: int, probe_key=None) -> int:
         """Time the pipelined dispatch loop with REAL train steps, then roll
         the engine state back to the snapshot — the probe leaves the
         optimizer trajectory exactly as if it never ran, so auto-fused and
         pinned runs train identically. Gated first on the analytic
         compute estimate (cheap: the AOT lowering shares the jit executable
         cache), so compute-dominated models skip both the probe and the
-        snapshot copy of params+opt_state."""
+        snapshot copy of params+opt_state. Results persist into the compile
+        plane's aux store, so a warm restart skips the probe dispatches
+        entirely, not just the compile."""
         import jax
         import jax.numpy as jnp
         eng = self.engine
+        cache = eng.compile_cache
         # the probe's throwaway epoch() must not advance the iterator's
         # shuffle-seed counter, or auto runs would see different data orders
         # than pinned runs — restore it on EVERY exit path
         epoch_counter = getattr(it, "_epoch", None)
         gen = it.epoch(shuffle=False, prefetch=False)
         snap = None
+        aux_key = None
         try:
             b0 = next(gen)
+            if cache is not None:
+                aux_key = self._probe_aux_key(
+                    eng.train_step_cache_key(b0), probe_key)
+                if aux_key is not None:
+                    stored = cache.get_aux("fuse", aux_key)
+                    if stored is not None:
+                        return int(stored)
             compute_s = learn_utils.estimate_step_compute_s(
                 eng.ensure_jit_train(),
                 (eng.params, eng.extra_vars, eng.opt_state,
                  jnp.asarray(eng.step), b0.x, b0.y, b0.w),
                 list(self.mesh.devices.flat))
             if compute_s is not None and compute_s >= 0.01:
-                return 1    # compute-dominated: nothing worth amortizing
+                # compute-dominated: nothing worth amortizing
+                if cache is not None and aux_key is not None:
+                    cache.put_aux("fuse", aux_key, 1)
+                return 1
             m = max(2, min(6, it.steps_per_epoch - 1,
                            int((64 << 20) // max(batch_bytes, 1)) or 2))
             probe = [b0]
@@ -377,6 +410,8 @@ class TPUEstimator:
         if k > 1:
             logger.info("fusing %d train steps per dispatch "
                         "(pipelined probe %.2f ms/step)", k, dt * 1e3)
+        if cache is not None and aux_key is not None:
+            cache.put_aux("fuse", aux_key, int(k))
         return k
 
     def _fit_loop(self, it, epochs, steps_per_epoch, batch_size,
@@ -579,14 +614,25 @@ class TPUEstimator:
                 for a in tuple(it.x) + tuple(it.y or ()))
             k = self._fuse_probe_cache.get(key)
             if k is None:
-                k = self._auto_probe_eval_fuse(it, sample, batch_bytes)
+                k = self._auto_probe_eval_fuse(it, sample, batch_bytes,
+                                               probe_key=key)
                 self._fuse_probe_cache[key] = k
         return self._apply_fuse_caps(k, batch_bytes, it.steps_per_epoch)
 
-    def _auto_probe_eval_fuse(self, it, sample, batch_bytes: int) -> int:
+    def _auto_probe_eval_fuse(self, it, sample, batch_bytes: int,
+                              probe_key=None) -> int:
         import jax
         eng = self.engine
+        cache = eng.compile_cache
         states = eng.init_metric_states()
+        aux_key = None
+        if cache is not None:
+            aux_key = self._probe_aux_key(
+                eng.eval_step_cache_key(states, sample), probe_key)
+            if aux_key is not None:
+                stored = cache.get_aux("fuse", aux_key)
+                if stored is not None:
+                    return int(stored)
         states, loss, _ = eng.eval_batch(states, sample)   # compile
         jax.block_until_ready(loss)
         compute_s = learn_utils.estimate_step_compute_s(
@@ -595,6 +641,8 @@ class TPUEstimator:
              sample.w),
             list(self.mesh.devices.flat))
         if compute_s is not None and compute_s >= 0.01:
+            if cache is not None and aux_key is not None:
+                cache.put_aux("fuse", aux_key, 1)
             return 1
         dt = float("inf")
         m = 6
@@ -604,9 +652,12 @@ class TPUEstimator:
                 states, loss, _ = eng.eval_batch(states, sample)
             jax.block_until_ready(loss)
             dt = min(dt, (time.perf_counter() - t0) / m)
-        return learn_utils.auto_fuse_factor(dt, it.steps_per_epoch,
-                                            batch_bytes=batch_bytes,
-                                            compute_s=compute_s)
+        k = learn_utils.auto_fuse_factor(dt, it.steps_per_epoch,
+                                         batch_bytes=batch_bytes,
+                                         compute_s=compute_s)
+        if cache is not None and aux_key is not None:
+            cache.put_aux("fuse", aux_key, int(k))
+        return k
 
     # --- predict ------------------------------------------------------------
     def predict(self, data, batch_size: int = 32, feature_cols=None,

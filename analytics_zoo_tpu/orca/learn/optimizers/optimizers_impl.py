@@ -17,6 +17,21 @@ import optax
 from .schedule import Default, Scheduler
 
 
+def _inject_lr(build, learning_rate) -> optax.GradientTransformation:
+    """Route the scalar learning rate through ``optax.inject_hyperparams``
+    so it lives in ``opt_state`` (a traced *argument* of the jitted train
+    step) instead of being baked into the executable as a constant. Trials/
+    engines that differ only in lr then lower to the SAME program and share
+    one XLA executable through the compile plane. Only ``learning_rate`` is
+    injected — betas/eps/momentum stay python floats, which keeps the
+    update bit-identical to the baked-constant form (injecting them would
+    round the bias corrections through f32 arrays)."""
+    try:
+        return optax.inject_hyperparams(build)(learning_rate=learning_rate)
+    except Exception:  # noqa: BLE001 — exotic optax build: bake as before
+        return build(learning_rate)
+
+
 class Optimizer:
     """Base wrapper: ``to_optax()`` yields an optax.GradientTransformation."""
 
@@ -26,6 +41,15 @@ class Optimizer:
 
     def _lr_schedule(self):
         return self.schedule.to_optax(self.lr)
+
+    def _injectable(self) -> bool:
+        """Constant-lr configs inject a plain float (the executable becomes
+        lr-polymorphic); scheduled/decayed configs keep the exact legacy
+        construction — their lr trajectory is a baked function of the step
+        count, so there is nothing to share across lr values."""
+        return (type(self.schedule) is Default
+                and not getattr(self, "decay", 0.0)
+                and not getattr(self, "lr_decay", 0.0))
 
     def to_optax(self) -> optax.GradientTransformation:
         raise NotImplementedError
@@ -42,9 +66,15 @@ class SGD(Optimizer):
         self.weightdecay = weightdecay
 
     def to_optax(self):
-        tx = optax.sgd(self._lr_schedule(),
-                       momentum=self.momentum or None,
-                       nesterov=self.nesterov)
+        if self._injectable():
+            tx = _inject_lr(
+                lambda learning_rate: optax.sgd(
+                    learning_rate, momentum=self.momentum or None,
+                    nesterov=self.nesterov), float(self.lr))
+        else:
+            tx = optax.sgd(self._lr_schedule(),
+                           momentum=self.momentum or None,
+                           nesterov=self.nesterov)
         if self.weightdecay:
             tx = optax.chain(optax.add_decayed_weights(self.weightdecay), tx)
         return tx
@@ -60,6 +90,11 @@ class Adam(Optimizer):
         self.b1, self.b2, self.eps, self.decay = beta_1, beta_2, epsilon, decay
 
     def to_optax(self):
+        if self._injectable():
+            return _inject_lr(
+                lambda learning_rate: optax.adam(
+                    learning_rate, b1=self.b1, b2=self.b2, eps=self.eps),
+                float(self.lr))
         sched = self._lr_schedule()
         if self.decay:
             base = sched
@@ -82,6 +117,11 @@ class AdamWeightDecay(Optimizer):
         self.wd, self.b1, self.b2, self.eps = weight_decay, beta_1, beta_2, epsilon
 
     def to_optax(self):
+        if self._injectable():
+            return _inject_lr(
+                lambda learning_rate: optax.adamw(
+                    learning_rate, b1=self.b1, b2=self.b2, eps=self.eps,
+                    weight_decay=self.wd), float(self.lr))
         return optax.adamw(self._lr_schedule(), b1=self.b1, b2=self.b2,
                            eps=self.eps, weight_decay=self.wd)
 
@@ -95,11 +135,19 @@ class Adagrad(Optimizer):
         self.lr_decay, self.weightdecay = learningrate_decay, weightdecay
 
     def to_optax(self):
-        sched = self._lr_schedule()
-        if self.lr_decay:
-            base = sched
-            sched = lambda step: base(step) / (1.0 + self.lr_decay * step)
-        tx = optax.adagrad(sched)
+        if self._injectable():
+            # lambda narrows the injected signature to learning_rate only
+            # (inject_hyperparams would otherwise lift numeric defaults
+            # like eps into f32 state, changing rounding)
+            tx = _inject_lr(
+                lambda learning_rate: optax.adagrad(learning_rate),
+                float(self.lr))
+        else:
+            sched = self._lr_schedule()
+            if self.lr_decay:
+                base = sched
+                sched = lambda step: base(step) / (1.0 + self.lr_decay * step)
+            tx = optax.adagrad(sched)
         if self.weightdecay:
             tx = optax.chain(optax.add_decayed_weights(self.weightdecay), tx)
         return tx
@@ -113,6 +161,11 @@ class Adadelta(Optimizer):
         self.rho, self.eps = decayrate, epsilon
 
     def to_optax(self):
+        if self._injectable():
+            return _inject_lr(
+                lambda learning_rate: optax.adadelta(
+                    learning_rate, rho=self.rho, eps=self.eps),
+                float(self.lr))
         return optax.adadelta(self._lr_schedule(), rho=self.rho, eps=self.eps)
 
 
@@ -125,6 +178,11 @@ class Adamax(Optimizer):
         self.b1, self.b2, self.eps = beta_1, beta_2, epsilon
 
     def to_optax(self):
+        if self._injectable():
+            return _inject_lr(
+                lambda learning_rate: optax.adamax(
+                    learning_rate, b1=self.b1, b2=self.b2, eps=self.eps),
+                float(self.lr))
         return optax.adamax(self._lr_schedule(), b1=self.b1, b2=self.b2,
                             eps=self.eps)
 
@@ -138,6 +196,13 @@ class RMSprop(Optimizer):
         self.decay, self.eps = decayrate, epsilon
 
     def to_optax(self):
+        # NB: RMSprop's ``decay`` is the moment decay rate, not an lr decay
+        # — it does not bake the lr, so injection stays available
+        if type(self.schedule) is Default:
+            return _inject_lr(
+                lambda learning_rate: optax.rmsprop(
+                    learning_rate, decay=self.decay, eps=self.eps),
+                float(self.lr))
         return optax.rmsprop(self._lr_schedule(), decay=self.decay,
                              eps=self.eps)
 

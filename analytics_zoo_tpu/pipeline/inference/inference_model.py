@@ -55,11 +55,20 @@ class InferenceModel:
 
     def __init__(self, supported_concurrent_num: int = 1,
                  batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 mesh=None):
+                 mesh=None, compile_cache=None):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ...compile import resolve_cache
         # concurrency arg kept for API parity; XLA executables are reentrant
         self.concurrency = supported_concurrent_num
+        # serving compiles through the process-wide compile plane: bucket
+        # executables are shared with any other model serving the same
+        # program, persist to the disk cache when one is configured (warm
+        # worker restarts skip bucket compilation), and precompile's
+        # compiles-vs-hits show up in compile_stats(). False -> plain jit.
+        self._cc = resolve_cache(compile_cache)
+        self._jit_apply = None
         if mesh is None:
             mesh = Mesh(np.array(jax.local_devices()), ("dp",))
         self.mesh = mesh
@@ -72,6 +81,8 @@ class InferenceModel:
             {math.ceil(b / self._ndev) * self._ndev for b in batch_buckets}))
         self._apply_fn: Optional[Callable] = None
         self._variables = None
+        # warmed (bucket, signature) registry; the executables themselves
+        # live in the shared ExecutableCache (or the jit wrapper's cache)
         self._cache: Dict[Tuple, Callable] = {}
         self._lock = threading.Lock()
         # call_tf-backed loaders set this: jax2tf.call_tf under jit requires
@@ -85,6 +96,13 @@ class InferenceModel:
         """Chips one predict() actually computes on (1 for eager/call_tf
         models, which run TF kernels host-side)."""
         return 1 if self._eager else self._ndev
+
+    def _reset_executables(self):
+        """New apply_fn/variables: drop the warmed-signature registry and
+        the cached-function wrapper (the shared cache keeps old entries —
+        they are keyed by program, so they can never be served wrongly)."""
+        self._cache.clear()
+        self._jit_apply = None
 
     def _shard_batch(self, arr):
         """Place one padded input on the mesh, batch dim sharded."""
@@ -106,7 +124,7 @@ class InferenceModel:
         self._apply_fn = apply_fn
         self._variables = jax.device_put(variables, self._repl)
         self._eager = False
-        self._cache.clear()
+        self._reset_executables()
         return self
 
     # --- int8 weight quantization -------------------------------------------
@@ -166,7 +184,7 @@ class InferenceModel:
 
         self._apply_fn = apply_fn
         self._variables = jax.device_put(q_vars, self._repl)
-        self._cache.clear()
+        self._reset_executables()
         logger.info("quantized %d weight tensors to int8", n_quantized)
         return self
 
@@ -253,7 +271,7 @@ class InferenceModel:
             self._apply_fn = donor._apply_fn
             self._variables = donor._variables
             self._eager = donor._eager
-            self._cache.clear()
+            self._reset_executables()
             return self
         model = tf.keras.models.load_model(model_path)
         try:
@@ -286,7 +304,7 @@ class InferenceModel:
             self._apply_fn = apply_fn
             self._variables = {}
             self._eager = True
-            self._cache.clear()
+            self._reset_executables()
             return self
 
     def load_openvino(self, *args, **kwargs):
@@ -309,7 +327,7 @@ class InferenceModel:
         self._apply_fn = apply_fn
         self._variables = None
         self._eager = False
-        self._cache.clear()
+        self._reset_executables()
         return self
 
     # --- predict ------------------------------------------------------------
@@ -392,9 +410,20 @@ class InferenceModel:
         with self._lock:
             fn = self._cache.get(key)
             if fn is None:
-                fn = jax.jit(self._apply_fn)
+                if self._jit_apply is None:
+                    self._jit_apply = (
+                        self._cc.wrap(self._apply_fn, label="serving")
+                        if self._cc is not None
+                        else jax.jit(self._apply_fn))
+                fn = self._jit_apply
                 self._cache[key] = fn
         return fn(self._variables, *dev)
+
+    def compile_stats(self) -> Dict:
+        """Compile-plane counters for this model's executable cache
+        (empty when the plane is disabled) — lets the serving engine's
+        ``precompile`` timer distinguish cache hits from real compiles."""
+        return self._cc.stats.snapshot() if self._cc is not None else {}
 
     def distributed_predict(self, shards, batch_size: int = 64):
         """Predict over XShards (reference: PythonOrca.

@@ -172,12 +172,22 @@ class ClusterServing:
     def metrics(self) -> Dict:
         """(reference observability: Flink numRecordsOutPerSecond +
         Timer stats)"""
-        return {"records_out": self.records_out,
-                # batch-dim sharding spreads every batch over these chips
-                # (reference scales with model replicas / Flink parallelism);
-                # 1 for eager/call_tf models, which compute host-side
-                "devices": getattr(self.model, "device_count", 1),
-                "stages": self.timer.summary()}
+        out = {"records_out": self.records_out,
+               # batch-dim sharding spreads every batch over these chips
+               # (reference scales with model replicas / Flink parallelism);
+               # 1 for eager/call_tf models, which compute host-side
+               "devices": getattr(self.model, "device_count", 1),
+               "stages": self.timer.summary()}
+        if hasattr(self.model, "compile_stats"):
+            # compiles vs cache/disk hits — read next to the "precompile"
+            # stage timer to see whether warmup paid real compilation or
+            # reused executables (in-process or from the disk cache). Empty
+            # when this model's plane is off: omit rather than clobber the
+            # process-wide counters the HTTP /metrics handler surfaces.
+            snap = self.model.compile_stats()
+            if snap:
+                out["compile"] = snap
+        return out
 
     def reset_metrics(self):
         """Zero the stage timers and record counter — call after warmup so

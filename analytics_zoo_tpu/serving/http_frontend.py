@@ -72,7 +72,11 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         # keep it off the event loop like the predict handler's fetches
         loop = asyncio.get_running_loop()
         pending = await loop.run_in_executor(None, broker.pending)
-        body = {"pending": pending}
+        from ..compile import compile_stats
+        # compile-plane counters are surfaced even without an embedded
+        # worker (an external worker in this process shares the cache);
+        # serving.metrics() refines them with the served model's own view
+        body = {"pending": pending, "compile": compile_stats()}
         if serving is not None:
             body.update(serving.metrics())
         return web.json_response(body)

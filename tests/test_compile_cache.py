@@ -1,0 +1,316 @@
+"""Compile plane suite: shared + persistent XLA executable cache.
+
+The claims under test mirror ISSUE 3's acceptance criteria: structurally
+identical engines share ONE executable even when scalar hyperparameters
+differ (hyperparams-as-arguments), sharing never changes numerics
+(bit-identical losses vs the baked-constant/uncached path), structural
+changes (clip constants, mesh, shapes) miss, executables round-trip
+through the disk cache (or degrade cleanly), the stats counters account
+compiles/hits/seconds-saved, and a TrialRuntime study logs
+``compile``/``cache_hit`` events while an entire scalar-hyperparam rung
+compiles exactly once.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from analytics_zoo_tpu.compile import ExecutableCache
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+from analytics_zoo_tpu.orca.learn.optimizers import Adam
+
+
+class _MLP(nn.Module):
+    hidden: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(nn.relu(nn.Dense(self.hidden)(x)))[:, 0]
+
+
+def _data(n=64, features=4, seed=0):
+    r = np.random.RandomState(seed)
+    return {"x": r.rand(n, features).astype(np.float32),
+            "y": r.rand(n).astype(np.float32)}
+
+
+def _estimator(cache, lr=1e-3, **kw):
+    # steps_per_dispatch pinned to 1: these tests count single-step
+    # executables, not fuse-probe behavior (covered separately below)
+    return TPUEstimator(_MLP(), loss="mse", optimizer=Adam(lr=lr),
+                        config={"steps_per_dispatch": 1},
+                        compile_cache=cache, **kw)
+
+
+def _losses(stats):
+    return [e["train_loss"] for e in stats]
+
+
+# --- sharing across scalar hyperparameters ----------------------------------
+
+def test_two_engines_different_lr_share_one_executable(orca_context):
+    """Two engines with identical structure but different lr must share ONE
+    train-step executable (lr rides in opt_state via inject_hyperparams),
+    and the shared path must be bit-identical to the baked-constant
+    uncached path."""
+    data = _data()
+    cache = ExecutableCache()
+    est1 = _estimator(cache, lr=1e-3)
+    est1.fit(data, epochs=2, batch_size=16, shuffle=False, verbose=False)
+    snap = cache.stats.counts("train")
+    assert snap["compiles"] == 1 and snap["cache_hits"] == 0
+
+    est2 = _estimator(cache, lr=1e-1)
+    s2 = est2.fit(data, epochs=2, batch_size=16, shuffle=False,
+                  verbose=False)
+    snap = cache.stats.counts("train")
+    assert snap["compiles"] == 1, "second lr must NOT compile again"
+    assert snap["cache_hits"] == 1
+
+    # bit-identical to the baked-constant path: same lr, lr baked into the
+    # jit as a constant, compile plane off
+    import optax
+    est3 = TPUEstimator(_MLP(), loss="mse", optimizer=optax.adam(1e-1),
+                        config={"steps_per_dispatch": 1},
+                        compile_cache=False)
+    s3 = est3.fit(data, epochs=2, batch_size=16, shuffle=False,
+                  verbose=False)
+    assert _losses(s2) == _losses(s3)
+
+
+def test_identical_refit_is_a_cache_hit_and_bit_identical(orca_context):
+    """Acceptance: a second in-process fit of an identical model reports a
+    cache hit, with losses bit-identical to the uncached (plain-jit)
+    path."""
+    data = _data()
+    cache = ExecutableCache()
+    est1 = _estimator(cache, lr=3e-3)
+    s1 = est1.fit(data, epochs=2, batch_size=16, shuffle=False,
+                  verbose=False)
+    est2 = _estimator(cache, lr=3e-3)
+    s2 = est2.fit(data, epochs=2, batch_size=16, shuffle=False,
+                  verbose=False)
+    snap = cache.stats.counts("train")
+    assert snap["compiles"] == 1 and snap["cache_hits"] == 1
+    assert _losses(s1) == _losses(s2)
+
+    uncached = _estimator(False, lr=3e-3)
+    s3 = uncached.fit(data, epochs=2, batch_size=16, shuffle=False,
+                      verbose=False)
+    assert _losses(s2) == _losses(s3)
+    # plain jit, not a CachedFunction
+    assert not hasattr(uncached.engine.ensure_jit_train(), "cache_key")
+
+
+# --- structural changes must miss -------------------------------------------
+
+def test_cache_miss_on_clip_change(orca_context):
+    data = _data()
+    cache = ExecutableCache()
+    est = _estimator(cache)
+    est.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    assert cache.stats.counts("train")["compiles"] == 1
+    est.set_l2_norm_gradient_clipping(1.0)
+    est.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    snap = cache.stats.counts("train")
+    assert snap["compiles"] == 2, "clip constants are part of the program"
+    # same clip config from a fresh engine: hit again
+    est2 = _estimator(cache)
+    est2.set_l2_norm_gradient_clipping(1.0)
+    est2.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    assert cache.stats.counts("train")["compiles"] == 2
+    assert cache.stats.counts("train")["cache_hits"] >= 1
+
+
+def test_cache_miss_on_shape_change(orca_context):
+    data = _data()
+    cache = ExecutableCache()
+    est = _estimator(cache)
+    est.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    est.fit(data, epochs=1, batch_size=32, shuffle=False, verbose=False)
+    assert cache.stats.counts("train")["compiles"] == 2
+
+
+def test_cache_miss_on_mesh_change(orca_context):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.local_devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    sub = Mesh(np.asarray(devs[:4]).reshape(4, 1, 1, 1),
+               ("dp", "fsdp", "tp", "sp"))
+    data = _data()
+    cache = ExecutableCache()
+    est1 = _estimator(cache)
+    est1.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    est2 = _estimator(cache, mesh=sub)
+    est2.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    snap = cache.stats.counts("train")
+    assert snap["compiles"] == 2, "a different mesh is a different program"
+
+
+# --- persistence ------------------------------------------------------------
+
+def test_disk_round_trip_or_clean_fallback(orca_context, tmp_path):
+    """A second cache instance over the same directory (a simulated warm
+    restart) must either load the executable from disk (serialization
+    supported — it is on CPU PJRT) or recompile cleanly; numerics are
+    identical either way."""
+    data = _data()
+    cache1 = ExecutableCache(cache_dir=str(tmp_path))
+    s1 = _estimator(cache1).fit(data, epochs=1, batch_size=16,
+                                shuffle=False, verbose=False)
+    assert cache1.stats.counts("train")["compiles"] == 1
+
+    cache2 = ExecutableCache(cache_dir=str(tmp_path))
+    s2 = _estimator(cache2).fit(data, epochs=1, batch_size=16,
+                                shuffle=False, verbose=False)
+    snap = cache2.stats.counts("train")
+    # disk hit when the backend serializes; clean recompile otherwise —
+    # never a crash, never a numeric change
+    assert snap["disk_hits"] + snap["compiles"] >= 1
+    if snap["disk_hits"]:
+        assert snap["compiles"] == 0
+    assert _losses(s1) == _losses(s2)
+
+
+def test_fuse_probe_persisted_across_restart(orca_context, tmp_path):
+    """Satellite: the estimator's auto fuse-probe result rides the disk
+    cache keyed by the train step's structural key — a warm restart skips
+    the probe's timing dispatches AND the state snapshot, not just the
+    compile."""
+    data = _data(n=128)
+    cache1 = ExecutableCache(cache_dir=str(tmp_path))
+    est1 = TPUEstimator(_MLP(), loss="mse", optimizer=Adam(lr=1e-3),
+                        compile_cache=cache1)
+    est1.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    k1 = next(iter(est1._fuse_probe_cache.values()))
+    aux_files = [f for f in os.listdir(tmp_path) if f.startswith("aux-fuse")]
+    assert aux_files, "probe result must be persisted"
+
+    cache2 = ExecutableCache(cache_dir=str(tmp_path))
+    est2 = TPUEstimator(_MLP(), loss="mse", optimizer=Adam(lr=1e-3),
+                        compile_cache=cache2)
+    # the probe needs a device-state snapshot; the persisted path must not
+    est2.engine.snapshot = lambda: pytest.fail(
+        "fuse probe ran despite a persisted result")
+    est2.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    assert next(iter(est2._fuse_probe_cache.values())) == k1
+
+
+# --- stats ------------------------------------------------------------------
+
+def test_stats_counters_and_reset(orca_context):
+    data = _data()
+    cache = ExecutableCache()
+    _estimator(cache).fit(data, epochs=1, batch_size=16, shuffle=False,
+                          verbose=False)
+    _estimator(cache).fit(data, epochs=1, batch_size=16, shuffle=False,
+                          verbose=False)
+    snap = cache.stats.snapshot()
+    assert snap["compiles"] >= 1
+    assert snap["cache_hits"] >= 1
+    assert snap["compile_s"] > 0
+    assert snap["saved_s"] > 0
+    assert snap["fallbacks"] == 0
+    assert "train" in snap["by_label"]
+    cache.stats.reset()
+    zero = cache.stats.snapshot()
+    assert zero["compiles"] == 0 and zero["by_label"] == {}
+
+
+def test_data_pipeline_stats_carries_compile_section(orca_context):
+    data = _data()
+    est = _estimator(ExecutableCache())
+    est.fit(data, epochs=1, batch_size=16, shuffle=False, verbose=False)
+    snap = est.data_pipeline_stats()
+    assert snap["compile"]["compiles"] >= 1
+
+
+# --- serving ----------------------------------------------------------------
+
+def test_serving_precompile_counts_and_shares(orca_context):
+    import jax
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import ClusterServing, InMemoryBroker
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    cache = ExecutableCache()
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4), np.float32))
+    model = InferenceModel(compile_cache=cache).load_jax(module, variables)
+    serving = ClusterServing(model, queue=InMemoryBroker(),
+                             batch_size=8).start(
+        example=np.zeros((2, 4), np.float32))
+    try:
+        warm = cache.stats.counts("serving")
+        assert warm["compiles"] >= 1 and warm["cache_hits"] == 0
+        metrics = serving.metrics()
+        assert metrics["compile"]["compiles"] == warm["compiles"]
+    finally:
+        serving.stop()
+
+    # a second worker serving the same program compiles nothing
+    model2 = InferenceModel(compile_cache=cache).load_jax(
+        Net(), Net().init(jax.random.PRNGKey(1),
+                          np.zeros((1, 4), np.float32)))
+    model2.precompile(np.zeros((2, 4), np.float32), max_bucket=8)
+    after = cache.stats.counts("serving")
+    assert after["compiles"] == warm["compiles"]
+    assert after["cache_hits"] >= 1
+
+
+# --- AutoML: one compile per rung + study event log -------------------------
+
+def _mlp_builder():
+    from analytics_zoo_tpu.automl.model_builder import ModelBuilder
+
+    def model_creator(config):
+        return _MLP()
+
+    return ModelBuilder(model_creator, loss_creator=lambda c: "mse")
+
+
+def test_asha_rung_compiles_once_and_logs_events(orca_context, tmp_path):
+    """Acceptance: a 4-trial study over scalar lr (same model/shape) on one
+    chip performs exactly ONE train-step compile; the study's JSONL event
+    log records the compile and every reuse as ``compile``/``cache_hit``
+    lines."""
+    import jax
+    from analytics_zoo_tpu.automl.scheduler.runtime import TrialRuntime
+    from analytics_zoo_tpu.automl.search.search_engine import Trial
+
+    cache = ExecutableCache()
+    trials = [Trial(i, {"lr": lr, "batch_size": 16,
+                        "steps_per_dispatch": 1})
+              for i, lr in enumerate([1e-3, 3e-3, 1e-2, 3e-2])]
+    runtime = TrialRuntime(
+        trials, _mlp_builder(), _data(), metric="mse", metric_mode="min",
+        max_t=2, eta=2, grace_period=1,
+        devices=[jax.local_devices()[0]],     # one chip = one device key
+        compile_cache=cache, logs_dir=str(tmp_path))
+    done = runtime.run(resume=False)
+    assert all(t.state == "done" for t in done)
+
+    snap = cache.stats.counts("train")
+    assert snap["compiles"] == 1, \
+        f"an entire scalar-hyperparam rung must compile once, got {snap}"
+    assert snap["cache_hits"] == 3
+
+    events = [json.loads(line) for line in
+              open(os.path.join(tmp_path, "study_events.jsonl"))]
+    kinds = {e["event"] for e in events}
+    assert "compile" in kinds and "cache_hit" in kinds
+    compile_events = [e for e in events if e["event"] == "compile"]
+    assert all({"label", "key", "seconds"} <= set(e) for e in compile_events)
+    assert runtime.summary()["compile"]["cache_hits"] >= 3

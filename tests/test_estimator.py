@@ -254,8 +254,13 @@ def test_fused_dispatch_ragged_tail(orca_context):
 
 def test_failure_without_model_dir_raises(orca_context):
     x, y = make_linear_data()
+    # pin the per-step dispatch path: the monkeypatch below replaces only
+    # train_batch, and with auto fusion a structurally identical earlier
+    # test may have seeded the compile plane's shared fuse-probe result,
+    # steering the loop through train_batch_group instead
     est = Estimator.from_keras(linear_model_creator, loss="mse",
-                               optimizer="adam")
+                               optimizer="adam",
+                               config={"steps_per_dispatch": 1})
 
     def exploding(batch):
         raise RuntimeError("boom")
