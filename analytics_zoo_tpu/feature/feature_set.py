@@ -164,16 +164,16 @@ class DiskFeatureSet:
         self._last_emitted = emitted
 
     def _put_batch(self, b):
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..native.transfer import sharded_put
         from ..orca.learn.utils import Batch
 
         def put(a):
+            # per-device slice placement — each chip receives only its
+            # stripe of the batch (native/transfer.py)
             sh = NamedSharding(
                 self.mesh, P(*((("dp", "fsdp"),) + (None,) * (a.ndim - 1))))
-            if jax.process_count() > 1:
-                return jax.make_array_from_process_local_data(sh, a)
-            return jax.device_put(a, sh)
+            return sharded_put(a, sh)
 
         return Batch(x=tuple(put(a) for a in b.x),
                      y=tuple(put(a) for a in b.y) if b.y else None,

@@ -1,3 +1,5 @@
 from .infeed import InfeedPump, PipelineStats
 from .runtime import (Arena, NativeQueue, available, f32_to_bf16_bits,
                       gather_rows, pad_sequences, shuffled_indices, version)
+from .transfer import (StagingPool, narrow_wire, put_tree, sharded_put,
+                       staging_enabled, wire_nbytes)

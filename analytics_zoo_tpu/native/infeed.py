@@ -4,20 +4,27 @@ The reference hides infeed latency with per-executor JVM threads pulling from
 Spark block manager (SURVEY.md §3.2); on TPU the equivalent is a three-stage
 pipeline that keeps the chip fed while the host assembles:
 
-  assembly workers (N threads)  →  one in-order H2D stage  →  consumer
-  gather/pad per batch, no GIL     jax.device_put, ordered     train loop
+  assembly workers (N threads)  →  H2D transfer lanes  →  consumer
+  gather/pad per batch, no GIL     parallel device_put,    train loop
+                                   in-order delivery
 
 A factory may yield either ready host batches (legacy contract, used by the
 streaming pipelines) or **zero-arg assembly tasks** (callables); tasks are
-fanned out over N workers and re-ordered before the single H2D stage, so
-slow batch assembly no longer serializes behind the transfer. The delivery
-queue's depth is adaptive: it grows while the consumer is observed starving
-(bounded by a host-memory budget), so a bursty producer gets buffer and a
-steady one stays at the configured depth.
+fanned out over N workers and re-ordered before the transfer stage, so slow
+batch assembly no longer serializes behind the transfer. The transfer stage
+itself runs up to ``lanes`` (``ZOO_H2D_LANES``, default 2) ``device_put``
+calls concurrently — DMA engines and the per-call dispatch latency overlap —
+while a FIFO future window keeps delivery strictly in batch order. The
+delivery queue's depth is adaptive: it grows while the consumer is observed
+starving (bounded by a host-memory budget), and when the H2D stage is the
+dominant producer-side cost the pump raises its lane count too (bounded by
+``MAX_H2D_LANES``), so a bursty producer gets buffer and a bandwidth-bound
+one gets parallel transfer streams.
 
 Every stage reports into a :class:`PipelineStats` — the counters surfaced
 by ``estimator.data_pipeline_stats()`` and printed by ``bench.py`` — so
-perf work can see where epoch time goes (assemble / H2D / step / stall).
+perf work can see where epoch time goes (assemble / H2D / step / stall),
+each stage's MB/s, and whether the run was ``transfer_limited``.
 """
 
 from __future__ import annotations
@@ -30,6 +37,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, Optional
 
 import jax
+
+from .transfer import MAX_H2D_LANES, default_h2d_lanes
 
 _STOP = object()
 _DONE = object()
@@ -51,6 +60,14 @@ class PipelineStats:
     ``step`` (engine dispatch, recorded by TrainEngine), ``stall`` (time
     the consumer waited on the delivery queue). Thread-safe; shared by the
     iterator, the pump, and the engine.
+
+    Stages that report bytes (H2D always; assemble when the pump feeds it)
+    get a ``<stage>_MBps`` rate in :meth:`snapshot`, and the snapshot carries
+    a ``transfer_limited`` verdict: cumulative H2D seconds exceed cumulative
+    step seconds, i.e. the wire — not the chip — bounds throughput. With
+    ``lanes`` transfer lanes running concurrently, ``h2d_s`` is the sum of
+    per-transfer times (per-lane seconds), so ``h2d_MBps`` is the average
+    per-lane rate; aggregate wire rate is up to ``lanes ×`` that.
     """
 
     STAGES = ("assemble", "h2d", "step", "stall")
@@ -63,10 +80,17 @@ class PipelineStats:
         with self._lock:
             self._time = {s: 0.0 for s in self.STAGES}
             self._count = {s: 0 for s in self.STAGES}
-            self.h2d_bytes = 0
+            self._bytes = {s: 0 for s in self.STAGES}
             self.depth = 0
             self.depth_peak = 0
             self.depth_growths = 0
+            self.lanes = 0
+            self.lane_growths = 0
+
+    @property
+    def h2d_bytes(self) -> int:
+        with self._lock:
+            return self._bytes["h2d"]
 
     def add(self, stage: str, seconds: float, count: int = 1,
             nbytes: int = 0):
@@ -74,7 +98,7 @@ class PipelineStats:
             self._time[stage] += seconds
             self._count[stage] += count
             if nbytes:
-                self.h2d_bytes += nbytes
+                self._bytes[stage] += nbytes
 
     def observe_depth(self, depth: int, grew: bool = False):
         with self._lock:
@@ -83,19 +107,45 @@ class PipelineStats:
             if grew:
                 self.depth_growths += 1
 
+    def observe_lanes(self, lanes: int, grew: bool = False):
+        with self._lock:
+            self.lanes = lanes
+            if grew:
+                self.lane_growths += 1
+
+    def stage_seconds(self) -> dict:
+        with self._lock:
+            return dict(self._time)
+
     def snapshot(self) -> dict:
         with self._lock:
             out = {}
             for s in self.STAGES:
                 out[f"{s}_s"] = round(self._time[s], 6)
                 out[f"{s}_n"] = self._count[s]
-            out["h2d_bytes"] = self.h2d_bytes
+                if self._bytes[s] and s != "h2d":
+                    out[f"{s}_bytes"] = self._bytes[s]
+                    out[f"{s}_MBps"] = (
+                        round(self._bytes[s] / self._time[s] / 1e6, 1)
+                        if self._time[s] > 0 else 0.0)
+            out["h2d_bytes"] = self._bytes["h2d"]
             out["h2d_MBps"] = (
-                round(self.h2d_bytes / self._time["h2d"] / 1e6, 1)
+                round(self._bytes["h2d"] / self._time["h2d"] / 1e6, 1)
                 if self._time["h2d"] > 0 else 0.0)
+            # the wire binds when transfer time beats compute-dispatch
+            # time. h2d_s SUMS per-lane seconds (lanes run concurrently),
+            # so normalize by the lane count to approximate the stage's
+            # wall time before comparing with the serial step stage; no
+            # verdict without both signals
+            out["transfer_limited"] = bool(
+                self._count["h2d"] and self._count["step"]
+                and self._time["h2d"] / max(self.lanes, 1)
+                > self._time["step"])
             out["depth"] = self.depth
             out["depth_peak"] = self.depth_peak
             out["depth_growths"] = self.depth_growths
+            out["lanes"] = self.lanes
+            out["lane_growths"] = self.lane_growths
             return out
 
 
@@ -178,7 +228,8 @@ class InfeedPump:
     batch_iter_factory : returns an iterator of host batches OR of zero-arg
         callables that assemble one (tasks get fanned out over ``workers``
         assembly threads and re-ordered).
-    device_put : staging function applied in-order by the single H2D stage.
+    device_put : staging function applied by the transfer lanes; delivery
+        stays in batch order regardless of per-transfer timing.
     depth : initial delivery-queue depth.
     max_depth : hard depth ceiling; default derives from the staging
         budget (``ZOO_INFEED_BUDGET_MB``, 256 MB — bounds HBM as well as
@@ -186,6 +237,9 @@ class InfeedPump:
         size, capped at 8.
     workers : assembly thread count (``ZOO_INFEED_WORKERS``, default
         min(4, cpus)); only used for task-yielding factories.
+    lanes : concurrent H2D transfers (``ZOO_H2D_LANES``, default 2); the
+        pump raises it adaptively up to ``MAX_H2D_LANES`` when the consumer
+        starves while the H2D stage dominates assembly.
     stats : shared :class:`PipelineStats`; a private one is created if
         omitted (exposed as ``pump.stats``).
     """
@@ -194,6 +248,8 @@ class InfeedPump:
                  device_put: Optional[Callable] = None, depth: int = 2,
                  max_depth: Optional[int] = None,
                  workers: Optional[int] = None,
+                 lanes: Optional[int] = None,
+                 max_lanes: Optional[int] = None,
                  stats: Optional[PipelineStats] = None,
                  host_mem_budget: Optional[int] = None):
         self._factory = batch_iter_factory
@@ -201,7 +257,15 @@ class InfeedPump:
         self._depth = max(1, depth)
         self._max_depth = max_depth
         self._workers = workers if workers is not None else _default_workers()
+        self._lanes = (max(1, min(int(lanes), MAX_H2D_LANES))
+                       if lanes is not None else default_h2d_lanes())
+        # adaptation ceiling (max_lanes=lanes pins the count, e.g. for the
+        # single-link crossover simulation)
+        self._max_lanes = (max(self._lanes, min(int(max_lanes),
+                                                MAX_H2D_LANES))
+                           if max_lanes is not None else MAX_H2D_LANES)
         self.stats = stats if stats is not None else PipelineStats()
+        self.stats.observe_lanes(self._lanes)
         self._budget = host_mem_budget if host_mem_budget is not None else (
             int(os.environ.get("ZOO_INFEED_BUDGET_MB",
                                str(_DEFAULT_BUDGET_MB))) << 20)
@@ -210,19 +274,47 @@ class InfeedPump:
     def _assemble(self, task):
         t0 = time.perf_counter()
         batch = task()
-        self.stats.add("assemble", time.perf_counter() - t0)
+        self.stats.add("assemble", time.perf_counter() - t0,
+                       nbytes=_batch_nbytes(batch))
         return batch
 
-    def _stage_h2d(self, q: _FlexQueue, host_batch) -> bool:
+    def _transfer(self, host_batch):
+        """One lane's work: stage a whole batch into HBM. Runs concurrently
+        on up to ``lanes`` threads; ordering is restored by the caller's
+        FIFO future window."""
         t0 = time.perf_counter()
         dev = self._device_put(host_batch)
         self.stats.add("h2d", time.perf_counter() - t0,
                        nbytes=_batch_nbytes(host_batch))
-        return q.put(dev)
+        return dev
 
     def _producer(self, q: _FlexQueue, err: list):
-        pool = None
-        window: deque = deque()     # in-flight assembly futures, in order
+        asm_pool = None
+        lane_pool = ThreadPoolExecutor(MAX_H2D_LANES,
+                                       thread_name_prefix="zoo-infeed-h2d")
+        asm_window: deque = deque()   # in-flight assembly futures, in order
+        h2d_window: deque = deque()   # in-flight transfer futures, in order
+
+        def deliver(drain: bool = False) -> bool:
+            """Move finished transfers to the delivery queue, oldest first:
+            completed heads always; still-running ones only on the
+            end-of-epoch ``drain``."""
+            while h2d_window and (drain or h2d_window[0].done()):
+                if not q.put(h2d_window.popleft().result()):
+                    return False
+            return True
+
+        def submit_h2d(host_batch) -> bool:
+            # cap in-flight transfers at the CURRENT lane count (it may
+            # have been raised adaptively mid-epoch) BEFORE submitting —
+            # the pool is sized for the ceiling, so the window is what
+            # bounds concurrency
+            while len(h2d_window) >= max(self._lanes, 1):
+                if not q.put(h2d_window.popleft().result()):
+                    return False
+            h2d_window.append(lane_pool.submit(self._transfer, host_batch))
+            return deliver()
+
         try:
             src = iter(self._factory())
             while True:
@@ -233,31 +325,35 @@ class InfeedPump:
                     break
                 if callable(item):
                     # assembly task: fan out, keep order via the window
-                    if pool is None:
-                        pool = ThreadPoolExecutor(
+                    if asm_pool is None:
+                        asm_pool = ThreadPoolExecutor(
                             self._workers,
                             thread_name_prefix="zoo-infeed-asm")
-                    window.append(pool.submit(self._assemble, item))
-                    # H2D the oldest once the window covers the workers —
-                    # its gather is done or about to be; later tasks keep
-                    # assembling meanwhile
-                    if len(window) > self._workers:
-                        if not self._stage_h2d(q, window.popleft().result()):
+                    asm_window.append(asm_pool.submit(self._assemble, item))
+                    # hand the oldest to the transfer lanes once the window
+                    # covers the workers — its gather is done or about to
+                    # be; later tasks keep assembling meanwhile
+                    if len(asm_window) > self._workers:
+                        if not submit_h2d(asm_window.popleft().result()):
                             return
                 else:
                     # legacy contract: the iterator assembled the batch in
                     # next(); that time IS the assemble stage
-                    self.stats.add("assemble", dt)
-                    if not self._stage_h2d(q, item):
+                    self.stats.add("assemble", dt,
+                                   nbytes=_batch_nbytes(item))
+                    if not submit_h2d(item):
                         return
-            while window:
-                if not self._stage_h2d(q, window.popleft().result()):
+            while asm_window:
+                if not submit_h2d(asm_window.popleft().result()):
                     return
+            if not deliver(drain=True):
+                return
         except Exception as e:          # surface on the consumer side
             err.append(e)
         finally:
-            if pool is not None:
-                pool.shutdown(wait=False, cancel_futures=True)
+            if asm_pool is not None:
+                asm_pool.shutdown(wait=False, cancel_futures=True)
+            lane_pool.shutdown(wait=False, cancel_futures=True)
             # Blocking put: the sentinel must never be dropped, or the
             # consumer hangs forever at epoch end. If the queue is full
             # (consumer stuck in a long first-step jit compile) this waits
@@ -274,6 +370,17 @@ class InfeedPump:
         if q.capacity < self._max_depth:
             q.grow(min(q.capacity * 2, self._max_depth))
             self.stats.observe_depth(q.capacity, grew=True)
+        # the consumer is starving while the producer still runs: when the
+        # H2D stage — not assembly — is the dominant producer-side cost,
+        # deeper buffering alone cannot help; open another transfer lane.
+        # h2d_s sums per-lane seconds, so normalize by the lane count
+        # before comparing (assemble stays summed: overestimating it only
+        # makes lane growth more conservative)
+        t = self.stats.stage_seconds()
+        if self._lanes < self._max_lanes and \
+                t["h2d"] / max(self._lanes, 1) > t["assemble"]:
+            self._lanes += 1
+            self.stats.observe_lanes(self._lanes, grew=True)
 
     def __iter__(self):
         q = _FlexQueue(self._depth)
@@ -297,6 +404,7 @@ class InfeedPump:
                     if wait > 1e-4 and t.is_alive():
                         # consumer starved while the producer still runs:
                         # deepen the buffer (bounded by the memory budget)
+                        # and/or open another transfer lane
                         self._maybe_grow(q, item)
                 first = False
                 yield item

@@ -69,7 +69,11 @@ def measure(device: FakeDevice, batches: List, steps: int,
 
     t0 = time.perf_counter()
     if use_pump:
-        for dev_batch in InfeedPump(factory, device_put=device.device_put):
+        # lanes=1: the FakeDevice models ONE DMA link as a sleep, so
+        # concurrent lane sleeps would simulate a doubled link, not
+        # overlapped transfers on the same link
+        for dev_batch in InfeedPump(factory, device_put=device.device_put,
+                                    lanes=1, max_lanes=1):
             device.train_step(dev_batch)
     else:
         for batch in factory():

@@ -21,7 +21,7 @@ contract the batch-stream equivalence tests in
 
 from __future__ import annotations
 
-from typing import List, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -130,11 +130,18 @@ class ChunkedArray:
                 pieces.append(self.chunks[c][lo:hi])
         return np.concatenate(pieces)
 
-    def gather(self, idx: np.ndarray) -> np.ndarray:
+    def gather(self, idx: np.ndarray,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
         """``out[i] = self[idx[i]]`` without materializing the dataset.
         Matches ndarray fancy-indexing semantics: boolean masks select,
         negative indices wrap, out-of-range indices raise IndexError
-        (never an OOB native read)."""
+        (never an OOB native read).
+
+        ``out`` is a destination *hint* for the allocating gather paths
+        (a reusable staging buffer — C-contiguous, gather shape/dtype);
+        the contiguous-run fast path still returns a zero-copy view, so
+        callers must use the RETURN value, which may or may not be
+        ``out``."""
         idx = np.asarray(idx)
         if idx.dtype == np.bool_:
             if idx.shape != (len(self),):
@@ -157,12 +164,18 @@ class ChunkedArray:
         if int(idx[-1]) - int(idx[0]) == n - 1 and (
                 n == 1 or bool((np.diff(idx) == 1).all())):
             return self.slice(int(idx[0]), int(idx[-1]) + 1)
+        if out is not None and (
+                out.shape != (n,) + self.chunks[0].shape[1:]
+                or out.dtype != self.dtype
+                or not out.flags.c_contiguous):
+            out = None              # unusable hint: fall back to allocating
         if len(self.chunks) == 1:
             from ...native import gather_rows
-            return gather_rows(self.chunks[0], idx)
+            return gather_rows(self.chunks[0], idx, out=out)
         pos = np.searchsorted(self.offsets, idx, side="right") - 1
         local = idx - self.offsets[pos]
-        out = np.empty((n,) + self.chunks[0].shape[1:], self.dtype)
+        if out is None:
+            out = np.empty((n,) + self.chunks[0].shape[1:], self.dtype)
         for c in np.unique(pos):
             sel = pos == c
             out[sel] = self.chunks[int(c)][local[sel]]

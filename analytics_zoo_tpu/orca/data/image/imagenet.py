@@ -166,14 +166,15 @@ class ImageNetPipeline:
 
     # --- device side ---------------------------------------------------------
     def _put_batch(self, b):
+        from analytics_zoo_tpu.native.transfer import sharded_put
         from ...learn.utils import Batch
 
         def put(a):
+            # per-device slice placement: each chip gets only its stripe of
+            # the uint8 batch — no full-batch replication before slicing
             sh = NamedSharding(self.mesh,
                                P(*((("dp", "fsdp"),) + (None,) * (a.ndim - 1))))
-            if jax.process_count() > 1:
-                return jax.make_array_from_process_local_data(sh, a)
-            return jax.device_put(a, sh)
+            return sharded_put(a, sh)
         return Batch(x=tuple(put(a) for a in b.x),
                      y=tuple(put(a) for a in b.y),
                      w=put(b.w) if b.w is not None else None)

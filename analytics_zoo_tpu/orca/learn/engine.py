@@ -64,7 +64,8 @@ class TrainEngine:
     def __init__(self, module, tx: optax.GradientTransformation,
                  loss_fn: Optional[Callable], metrics: Dict[str, Metric],
                  mesh: Mesh, seed: int = 0,
-                 fsdp_params: bool = False, compile_cache=None):
+                 fsdp_params: bool = False, compile_cache=None,
+                 prologue=None):
         from ...compile import resolve_cache
         # every jitted step goes through the process-wide compile plane
         # (ExecutableCache): structurally identical engines share ONE XLA
@@ -77,6 +78,11 @@ class TrainEngine:
         self.metrics = metrics
         self.mesh = mesh
         self.seed = seed
+        # on-device input prologue (BatchPrologue): cast/normalize/one-hot
+        # runs INSIDE every jitted step, so the host ships narrow source
+        # dtypes (uint8 images, int32 ids) and XLA fuses the float prologue
+        # into the first layer — see orca/learn/prologue.py
+        self.prologue = prologue
         self.fsdp_params = fsdp_params and mesh.shape.get("fsdp", 1) > 1
         self._train_kwarg = _module_train_kwarg(module)
         self.params = None
@@ -142,6 +148,10 @@ class TrainEngine:
             return
         rng = jax.random.PRNGKey(self.seed)
         small = tuple(jnp.asarray(a[:1]) for a in sample_x)
+        if self.prologue is not None:
+            # the module sees post-prologue tensors at init, exactly as it
+            # will inside the jitted steps
+            small = self.prologue.apply_x(small)
         variables = self._init_vars(rng, small)
         variables = dict(variables)
         # a parameterless graph (e.g. a pure merge/functional model) inits
@@ -297,8 +307,18 @@ class TrainEngine:
             return jnp.mean(per_ex)
         return jnp.sum(per_ex * w) / jnp.maximum(jnp.sum(w), 1e-8)
 
+    def _pre(self, x, y):
+        """Apply the on-device prologue (traced into every jitted step; a
+        no-op without one). The wire carries the narrow source dtypes; the
+        step starts by casting/normalizing them in f32 on device — bit-
+        identical to a host-side f32 pipeline, minus 2-4x the H2D bytes."""
+        if self.prologue is None:
+            return x, y
+        return self.prologue(x, y)
+
     # --- steps --------------------------------------------------------------
     def _train_step(self, params, extra, opt_state, step, x, y, w):
+        x, y = self._pre(x, y)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
 
         def loss_of(p):
@@ -333,6 +353,7 @@ class TrainEngine:
         return params, extra, opt_state, losses
 
     def _eval_step(self, params, extra, metric_states, x, y, w):
+        x, y = self._pre(x, y)
         preds, _ = self._apply(params, extra, x, False)
         loss = (self._compute_loss(y, preds, w)
                 if (y is not None or self.loss_fn is None) else jnp.zeros(()))
@@ -380,6 +401,7 @@ class TrainEngine:
         return out
 
     def _predict_step(self, params, extra, x):
+        x, _ = self._pre(x, None)
         preds, _ = self._apply(params, extra, x, False)
         return preds
 

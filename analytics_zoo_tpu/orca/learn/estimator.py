@@ -39,7 +39,8 @@ class Estimator:
                    model=None, config: Optional[dict] = None,
                    loss=None, optimizer="adam", metrics=None,
                    model_dir: Optional[str] = None, backend: str = "tpu",
-                   workers_per_node: int = 1, seed: int = 0):
+                   workers_per_node: int = 1, seed: int = 0,
+                   prologue=None):
         """Build an estimator from a flax module (or creator function), the
         TPU-native analogue of from_keras(model_creator) (reference:
         orca/learn/tf2/estimator.py:36-93). ``config`` is passed to the
@@ -50,7 +51,7 @@ class Estimator:
             module, loss, optimizer = module
         return TPUEstimator(module, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
-                            config=config, seed=seed)
+                            config=config, seed=seed, prologue=prologue)
 
     @staticmethod
     def from_jax(module=None, **kwargs):
@@ -71,7 +72,7 @@ class TPUEstimator:
     def __init__(self, module, loss=None, optimizer="adam", metrics=None,
                  model_dir: Optional[str] = None,
                  config: Optional[dict] = None, seed: int = 0, mesh=None,
-                 fsdp: bool = False, compile_cache=None):
+                 fsdp: bool = False, compile_cache=None, prologue=None):
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.module = module
@@ -84,9 +85,15 @@ class TPUEstimator:
         # ``compile_cache=False`` (arg or config key) opts out to plain jit
         if compile_cache is None:
             compile_cache = self.config.get("compile_cache", None)
+        # transfer plane: an on-device input prologue (orca/learn/prologue.
+        # BatchPrologue) moves cast/normalize/one-hot INSIDE the jitted
+        # step so the wire carries narrow source dtypes (uint8/int32)
+        if prologue is None:
+            prologue = self.config.get("prologue", None)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
                                   self.mesh, seed=seed, fsdp_params=fsdp,
-                                  compile_cache=compile_cache)
+                                  compile_cache=compile_cache,
+                                  prologue=prologue)
         # one stats object spans iterator assembly, the pump's H2D stage and
         # the engine's dispatches — the estimator is where they all meet
         from ...native.infeed import PipelineStats

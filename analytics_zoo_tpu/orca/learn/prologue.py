@@ -1,0 +1,210 @@
+"""On-device input preprocessing — the jitted step's "prologue".
+
+The reference normalizes images on the host inside its tf.data / DataSet
+pipelines (resnet-50-imagenet.py:44-230: decode → crop → flip → *normalize*
+→ batch), which forces the infeed to carry float32. On TPU the wire is the
+scarce resource (BENCH_DETAIL: ``transfer_limited`` on every streamed
+workload), so the float math moves INSIDE the jitted step: the host ships
+narrow source dtypes (uint8 pixels, int32 ids/labels) and the first thing
+the XLA program does is cast + normalize / one-hot — fused by XLA into the
+first real layer, effectively free, and a 4× H2D byte cut for images
+(~2× for int64-id workloads via the wire narrowing in
+:mod:`analytics_zoo_tpu.native.transfer`).
+
+Bit-identity contract: every op here computes in float32 with the same
+formula a host-side numpy pipeline would use, so "normalize on device"
+produces the exact bits of "normalize on host, ship f32" — pinned by
+``tests/test_transfer_plane.py``. Each :class:`LeafOp` therefore carries
+both the device (jax) and the host (numpy) implementation; ``host`` is the
+reference float path used by the equivalence tests and by callers that
+need to precompute what the device will see.
+
+Usage::
+
+    from analytics_zoo_tpu.orca.learn.prologue import (
+        BatchPrologue, image_normalize)
+
+    est = TPUEstimator(module, loss=..., optimizer=...,
+                       prologue=BatchPrologue(x=(image_normalize(),)))
+    est.fit({"x": uint8_images, "y": int32_labels}, ...)
+
+The prologue rides into every jitted train/eval/predict step (and the
+module's ``init``), so checkpoints, the compile plane, and the scan-fused
+multi-step path all see the post-prologue float tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+# f32 channel stats in 0-255 scale (torchvision/reference constants) —
+# re-exported from the imagenet pipeline so there is exactly one copy
+from ..data.image.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+__all__ = ["LeafOp", "BatchPrologue", "image_normalize", "rescale",
+           "one_hot", "cast", "compose"]
+
+
+class LeafOp:
+    """One per-tensor prologue op: a device (jax) implementation used
+    inside the jitted step and a host (numpy) twin used as the reference
+    float path. The two must be bit-identical on f32."""
+
+    def __init__(self, device_fn: Callable, host_fn: Callable,
+                 name: str = "leaf_op"):
+        self._device = device_fn
+        self._host = host_fn
+        self.name = name
+
+    def __call__(self, a):
+        return self._device(a)
+
+    def host(self, a: np.ndarray) -> np.ndarray:
+        return self._host(a)
+
+    def __repr__(self):
+        return f"LeafOp({self.name})"
+
+
+def image_normalize(mean: Sequence[float] = IMAGENET_MEAN,
+                    std: Sequence[float] = IMAGENET_STD) -> LeafOp:
+    """uint8 pixels → f32 ``(x - mean) * (1/std)`` per channel. The inverse
+    std is precomputed in f32 so device and host multiply by the same
+    bits."""
+    mean_np = np.asarray(mean, np.float32)
+    inv_np = (np.float32(1.0) / np.asarray(std, np.float32)).astype(
+        np.float32)
+
+    def dev(a):
+        import jax.numpy as jnp
+        return (a.astype(jnp.float32) - jnp.asarray(mean_np)) \
+            * jnp.asarray(inv_np)
+
+    def host(a):
+        return ((a.astype(np.float32) - mean_np) * inv_np).astype(np.float32)
+
+    return LeafOp(dev, host, f"image_normalize(mean={tuple(mean)})")
+
+
+def rescale(factor: float = 1.0 / 255.0) -> LeafOp:
+    """uint8/int → f32 ``x * factor`` (e.g. the /255 pixel scaling)."""
+    f = np.float32(factor)
+
+    def dev(a):
+        import jax.numpy as jnp
+        return a.astype(jnp.float32) * jnp.float32(f)
+
+    def host(a):
+        return (a.astype(np.float32) * f).astype(np.float32)
+
+    return LeafOp(dev, host, f"rescale({factor})")
+
+
+def one_hot(num_classes: int) -> LeafOp:
+    """int labels → f32 one-hot rows (ships 4·k× fewer bytes than host-side
+    one-hot for k classes; int32 wire vs f32 dense)."""
+
+    def dev(a):
+        import jax
+        import jax.numpy as jnp
+        return jax.nn.one_hot(a, num_classes, dtype=jnp.float32)
+
+    def host(a):
+        # mirror jax.nn.one_hot exactly: out-of-range and negative labels
+        # produce an all-zero row (np.eye indexing would raise or wrap)
+        idx = np.asarray(a, np.int64)
+        flat = idx.reshape(-1)
+        out = np.zeros((flat.size, num_classes), np.float32)
+        ok = (flat >= 0) & (flat < num_classes)
+        out[np.nonzero(ok)[0], flat[ok]] = 1.0
+        return out.reshape(idx.shape + (num_classes,))
+
+    return LeafOp(dev, host, f"one_hot({num_classes})")
+
+
+def cast(dtype) -> LeafOp:
+    """Plain dtype cast (e.g. int labels that a loss wants as f32)."""
+
+    def dev(a):
+        import jax.numpy as jnp
+        return a.astype(jnp.dtype(dtype))
+
+    def host(a):
+        return a.astype(np.dtype(dtype))
+
+    return LeafOp(dev, host, f"cast({np.dtype(dtype).name})")
+
+
+def compose(*ops: LeafOp) -> LeafOp:
+    """Chain LeafOps left-to-right."""
+
+    def dev(a):
+        for op in ops:
+            a = op(a)
+        return a
+
+    def host(a):
+        for op in ops:
+            a = op.host(a)
+        return a
+
+    return LeafOp(dev, host, "∘".join(op.name for op in ops))
+
+
+def _as_ops(spec) -> Optional[Tuple[Optional[LeafOp], ...]]:
+    if spec is None:
+        return None
+    if isinstance(spec, LeafOp):
+        return (spec,)
+    return tuple(spec)
+
+
+class BatchPrologue:
+    """Per-leaf prologue for one batch: ``x``/``y`` are tuples of
+    :class:`LeafOp` (or None to pass a leaf through) aligned with the batch's
+    feature/label tuples. A single LeafOp is treated as a 1-tuple. A spec
+    shorter than the leaf tuple leaves the trailing leaves untouched; longer
+    is an error (it would silently drop user intent).
+    """
+
+    def __init__(self, x=None, y=None):
+        self.x_ops = _as_ops(x)
+        self.y_ops = _as_ops(y)
+
+    @staticmethod
+    def _apply(ops, leaves, host: bool):
+        if ops is None or leaves is None:
+            return leaves
+        if len(ops) > len(leaves):
+            raise ValueError(
+                f"prologue declares {len(ops)} ops for {len(leaves)} "
+                "batch leaves")
+        out = []
+        for i, leaf in enumerate(leaves):
+            op = ops[i] if i < len(ops) else None
+            if op is None:
+                out.append(leaf)
+            else:
+                out.append(op.host(leaf) if host else op(leaf))
+        return tuple(out)
+
+    # --- device side (traced inside the jitted step) -------------------------
+    def apply_x(self, x):
+        return self._apply(self.x_ops, x, host=False)
+
+    def __call__(self, x, y):
+        return self._apply(self.x_ops, x, host=False), \
+            self._apply(self.y_ops, y, host=False)
+
+    # --- host reference float path (tests, precomputation) -------------------
+    def host_x(self, x):
+        return self._apply(self.x_ops, x, host=True)
+
+    def host(self, x, y):
+        return self._apply(self.x_ops, x, host=True), \
+            self._apply(self.y_ops, y, host=True)
+
+    def __repr__(self):
+        return f"BatchPrologue(x={self.x_ops}, y={self.y_ops})"

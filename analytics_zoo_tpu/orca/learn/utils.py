@@ -22,7 +22,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ...native.infeed import PipelineStats
+from ...native import transfer as xfer
+from ...native.infeed import _MAX_DEPTH, PipelineStats, _default_workers
 from ...utils import nest
 from ..data.chunked import ChunkedArray, as_chunked
 from ..data.shard import HostXShards
@@ -267,6 +268,15 @@ class BatchIterator:
     dataset; ``batch_size`` is the *global* batch (the reference's TFDataset
     batch semantics, tf_dataset.py:135-149), so each host contributes
     batch_size / process_count rows per step.
+
+    Wire format: source dtypes are preserved end-to-end — uint8 pixels and
+    int32 labels ship as-is (cast/normalize belongs on device, see
+    ``orca/learn/prologue.py``) and wide leaves (f64/i64) are narrowed
+    per batch to their canonical device form (``narrow_wire`` — the cast
+    ``device_put`` would perform anyway, paid on the batch instead of as a
+    resident duplicate of the dataset). On the prefetch path, batch
+    gathers go into a reusable :class:`StagingPool` ring instead of fresh
+    allocations (non-CPU backends; see ``native/transfer.py``).
     """
 
     supports_fused = True       # capability flag: epoch(fuse=k) is available
@@ -284,6 +294,7 @@ class BatchIterator:
         self.y = (tuple(as_chunked(a) for a in data["y"])
                   if data.get("y") is not None else None)
         self.n = len(self.x[0])
+        self._staging = None        # lazily-built StagingPool (or False)
         self.stats = stats if stats is not None else PipelineStats()
         self.prefetch_depth = prefetch_depth
         self.prefetch_workers = prefetch_workers
@@ -330,31 +341,66 @@ class BatchIterator:
         return self._sharding_cache[key]
 
     def _device_put(self, arr: np.ndarray, fused: bool = False):
-        sh = self._sharding(arr.ndim, fused)
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sh, arr)
-        return jax.device_put(arr, sh)
+        """Place ONE array on the mesh (kept for callers staging single
+        leaves; batches go through :meth:`_put_batch`)."""
+        return xfer.sharded_put(arr, self._sharding(arr.ndim, fused))
 
-    def _assemble_group(self, idx: np.ndarray, fuse: int) -> Batch:
+    def _staging_pool(self):
+        """Reusable host gather buffers for the prefetch path. Ring sized
+        above the pump's WORST-CASE in-flight window — assembly workers,
+        the adaptive lane ceiling, the adaptive delivery-depth ceiling
+        (device_put may hold the host buffer until its async DMA
+        completes), the consumer's batch, and margin — so a buffer is
+        never rewritten while its batch may still be read. None when
+        staging is off (CPU backend — its device_put may alias numpy
+        buffers zero-copy; ``ZOO_HOST_STAGING`` overrides)."""
+        if self._staging is None:
+            if not xfer.staging_enabled():
+                self._staging = False
+            else:
+                workers = self.prefetch_workers or _default_workers()
+                self._staging = xfer.StagingPool(
+                    ring=workers + xfer.MAX_H2D_LANES
+                    + max(_MAX_DEPTH, self.prefetch_depth) + 4)
+        return self._staging or None
+
+    def _gather_leaf(self, a: ChunkedArray, idx: np.ndarray,
+                     staged: bool) -> np.ndarray:
+        # wide leaves bypass the ring: their narrow_wire astype allocates
+        # anyway, so staging a wide intermediate would just double the
+        # gathered bytes
+        if staged and xfer.narrows_to(a.dtype) is None:
+            pool = self._staging_pool()
+            if pool is not None:
+                out = pool.acquire((len(idx),) + a.shape[1:], a.dtype,
+                                   tag=id(a))
+                return a.gather(idx, out=out)
+        return xfer.narrow_wire(a.gather(idx))
+
+    def _assemble_group(self, idx: np.ndarray, fuse: int,
+                        staged: bool = False) -> Batch:
         """One stacked (fuse, local_bs, ...) superbatch."""
         xs = tuple(
-            a.gather(idx).reshape((fuse, self.local_bs) + a.shape[1:])
+            self._gather_leaf(a, idx, staged).reshape(
+                (fuse, self.local_bs) + a.shape[1:])
             for a in self.x)
         ys = (tuple(
-            a.gather(idx).reshape((fuse, self.local_bs) + a.shape[1:])
+            self._gather_leaf(a, idx, staged).reshape(
+                (fuse, self.local_bs) + a.shape[1:])
             for a in self.y) if self.y is not None else None)
         return Batch(x=xs, y=ys, w=None, fused=fuse)
 
-    def _assemble_batch(self, idx: np.ndarray,
-                        w: Optional[np.ndarray]) -> Batch:
+    def _assemble_batch(self, idx: np.ndarray, w: Optional[np.ndarray],
+                        staged: bool = False) -> Batch:
         """One plain batch; chunk-aware gather (a contiguous in-chunk index
         run comes back as a zero-copy view)."""
-        xs = tuple(a.gather(idx) for a in self.x)
-        ys = (tuple(a.gather(idx) for a in self.y)
+        xs = tuple(self._gather_leaf(a, idx, staged) for a in self.x)
+        ys = (tuple(self._gather_leaf(a, idx, staged) for a in self.y)
               if self.y is not None else None)
         return Batch(x=xs, y=ys, w=w)
 
-    def _host_batch_tasks(self, shuffle: bool, fuse: int = 1
+    def _host_batch_tasks(self, shuffle: bool, fuse: int = 1,
+                          staged: bool = False
                           ) -> Iterator[Callable[[], Batch]]:
         """Plan an epoch: yield zero-arg assembly tasks in batch order.
 
@@ -383,7 +429,8 @@ class BatchIterator:
         n_groups = self.n // group if fuse > 1 else 0
         for s in range(n_groups):
             yield partial(self._assemble_group,
-                          order[s * group:(s + 1) * group], fuse)
+                          order[s * group:(s + 1) * group], fuse,
+                          staged)
         done = n_groups * group
         tail_steps = (math.ceil((self.n - done) / self.local_bs)
                       if self.pad_tail
@@ -403,7 +450,7 @@ class BatchIterator:
                 # jitted step synthesize them, saving a per-step
                 # host->device transfer (the infeed is the scarce resource)
                 w = None
-            yield partial(self._assemble_batch, idx, w)
+            yield partial(self._assemble_batch, idx, w, staged)
 
     def _host_batches(self, shuffle: bool, fuse: int = 1) -> Iterator[Batch]:
         """Assembled host batches, inline (single-threaded) — the
@@ -412,23 +459,16 @@ class BatchIterator:
             yield task()
 
     def _put_batch(self, b: Batch) -> Batch:
-        """Stage a whole batch pytree into HBM with ONE ``jax.device_put``
-        call (per-leaf calls each pay dispatch overhead; the batched form
-        lets the runtime coalesce the transfers)."""
+        """Stage a whole batch pytree into HBM with per-leaf, batch-sharded
+        placement (``native.transfer.put_tree``): each chip receives ONLY
+        its slice of the batch, cut host-side — no full-batch replication
+        ahead of slicing. Multihost rides the same helper
+        (``make_array_from_process_local_data`` per leaf)."""
         fused = b.fused > 1
-        if jax.process_count() > 1:
-            # multihost assembly keeps the per-leaf form:
-            # make_array_from_process_local_data has no batched variant
-            return Batch(
-                x=tuple(self._device_put(a, fused) for a in b.x),
-                y=(tuple(self._device_put(a, fused) for a in b.y)
-                   if b.y is not None else None),
-                w=self._device_put(b.w, fused) if b.w is not None else None,
-                fused=b.fused)
         leaves = list(b.x) + list(b.y or ()) + (
             [b.w] if b.w is not None else [])
         shardings = [self._sharding(a.ndim, fused) for a in leaves]
-        put = jax.device_put(leaves, shardings)
+        put = xfer.put_tree(leaves, shardings)
         nx, ny = len(b.x), len(b.y or ())
         return Batch(
             x=tuple(put[:nx]),
@@ -456,11 +496,12 @@ class BatchIterator:
                 yield out
             return
         from analytics_zoo_tpu.native.infeed import InfeedPump
-        yield from InfeedPump(lambda: self._host_batch_tasks(shuffle, fuse),
-                              device_put=self._put_batch,
-                              depth=self.prefetch_depth,
-                              workers=self.prefetch_workers,
-                              stats=self.stats)
+        yield from InfeedPump(
+            lambda: self._host_batch_tasks(shuffle, fuse, staged=True),
+            device_put=self._put_batch,
+            depth=self.prefetch_depth,
+            workers=self.prefetch_workers,
+            stats=self.stats)
 
 
 def data_to_iterator(data: Any, batch_size: int, mesh: Mesh,

@@ -81,6 +81,14 @@ class InferenceModel:
             {math.ceil(b / self._ndev) * self._ndev for b in batch_buckets}))
         self._apply_fn: Optional[Callable] = None
         self._variables = None
+        # on-device input prologue (orca/learn/prologue.BatchPrologue):
+        # cast/normalize runs inside the jitted apply so requests ship
+        # narrow dtypes (uint8 images) — a 4x ingress byte cut
+        self._prologue = None
+        # h2d transfer telemetry for the serving path (surfaced by
+        # ClusterServing.metrics() and the HTTP /metrics endpoint)
+        from ...native.infeed import PipelineStats
+        self._tstats = PipelineStats()
         # warmed (bucket, signature) registry; the executables themselves
         # live in the shared ExecutableCache (or the jit wrapper's cache)
         self._cache: Dict[Tuple, Callable] = {}
@@ -105,11 +113,35 @@ class InferenceModel:
         self._jit_apply = None
 
     def _shard_batch(self, arr):
-        """Place one padded input on the mesh, batch dim sharded."""
-        import jax
+        """Place one padded input on the mesh, batch dim sharded: each chip
+        receives ONLY its slice (native/transfer.py sharded_put) instead of
+        the runtime replicating the full batch to every chip before
+        slicing; the transfer is recorded in :meth:`transfer_stats`."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...native.transfer import narrow_wire, sharded_put
         spec = self._data_spec if arr.ndim else P()
-        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        return sharded_put(narrow_wire(arr), NamedSharding(self.mesh, spec),
+                           stats=self._tstats)
+
+    def transfer_stats(self) -> Dict:
+        """Serving-ingress transfer counters (h2d seconds/bytes/MB/s) —
+        the data-plane twin of :meth:`compile_stats`."""
+        return self._tstats.snapshot()
+
+    def set_prologue(self, prologue) -> "InferenceModel":
+        """Fuse an on-device input prologue (cast + normalize + ...) into
+        the jitted apply, so clients enqueue narrow source dtypes (uint8
+        images, int32 ids) and the cast happens after the wire, not before.
+        Accepts a :class:`~analytics_zoo_tpu.orca.learn.prologue.
+        BatchPrologue` or a LeafOp / tuple of LeafOps for the positional
+        inputs. ``None`` clears it."""
+        from ...orca.learn.prologue import BatchPrologue
+        if prologue is not None and not isinstance(prologue, BatchPrologue):
+            self._prologue = BatchPrologue(x=prologue)
+        else:
+            self._prologue = prologue
+        self._reset_executables()
+        return self
 
     # --- loaders ------------------------------------------------------------
     def load_jax(self, module, variables) -> "InferenceModel":
@@ -411,10 +443,19 @@ class InferenceModel:
             fn = self._cache.get(key)
             if fn is None:
                 if self._jit_apply is None:
+                    base = self._apply_fn
+                    if self._prologue is not None:
+                        prol = self._prologue
+
+                        def base(variables, *x, _fn=self._apply_fn,
+                                 _p=prol):
+                            # prologue traced INSIDE the executable: XLA
+                            # fuses the cast/normalize into the first layer
+                            return _fn(variables, *_p.apply_x(tuple(x)))
                     self._jit_apply = (
-                        self._cc.wrap(self._apply_fn, label="serving")
+                        self._cc.wrap(base, label="serving")
                         if self._cc is not None
-                        else jax.jit(self._apply_fn))
+                        else jax.jit(base))
                 fn = self._jit_apply
                 self._cache[key] = fn
         return fn(self._variables, *dev)

@@ -178,6 +178,12 @@ class ClusterServing:
                # 1 for eager/call_tf models, which compute host-side
                "devices": getattr(self.model, "device_count", 1),
                "stages": self.timer.summary()}
+        if hasattr(self.model, "transfer_stats"):
+            # transfer-plane counters: serving-ingress h2d seconds/bytes/
+            # MB/s from the sharded device_put path (native/transfer.py)
+            snap = self.model.transfer_stats()
+            if snap and snap.get("h2d_n"):
+                out["transfer"] = snap
         if hasattr(self.model, "compile_stats"):
             # compiles vs cache/disk hits — read next to the "precompile"
             # stage timer to see whether warmup paid real compilation or

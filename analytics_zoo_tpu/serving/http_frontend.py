@@ -76,6 +76,7 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         # compile-plane counters are surfaced even without an embedded
         # worker (an external worker in this process shares the cache);
         # serving.metrics() refines them with the served model's own view
+        # and adds the transfer-plane snapshot ("transfer": h2d MB/s etc.)
         body = {"pending": pending, "compile": compile_stats()}
         if serving is not None:
             body.update(serving.metrics())

@@ -28,4 +28,34 @@ echo "DOTS_FAILED=$(printf '%s\n' "$fails" | grep -c . )"
 if [ -n "$fails" ]; then
     printf 'DOTS_FAILED_ID=%s\n' $fails
 fi
+# transfer-plane snapshot: per-stage MB/s + transfer_limited verdict from a
+# tiny CPU fit through the production pump (never affects the exit code)
+env JAX_PLATFORMS=cpu python - <<'EOF' 2>/dev/null || true
+import json
+import numpy as np
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+from analytics_zoo_tpu.orca.learn.prologue import BatchPrologue, image_normalize
+import flax.linen as nn
+
+init_orca_context("local")
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+rng = np.random.RandomState(0)
+est = TPUEstimator(M(), loss="sparse_categorical_crossentropy",
+                   optimizer="adam", config={"steps_per_dispatch": 1},
+                   prologue=BatchPrologue(x=(image_normalize(),)))
+est.fit({"x": rng.randint(0, 256, (256, 8, 8, 3), np.uint8),
+         "y": rng.randint(0, 4, 256).astype(np.int32)},
+        epochs=1, batch_size=32, verbose=False)
+snap = est.data_pipeline_stats()
+keys = ("assemble_MBps", "h2d_MBps", "h2d_bytes", "lanes",
+        "transfer_limited")
+print("TRANSFER_PLANE=" + json.dumps(
+    {k: snap[k] for k in keys if k in snap}))
+EOF
 exit $rc
