@@ -213,6 +213,8 @@ class TrialRuntime:
         self._halt = threading.Event()
         self._halt_reason: Optional[str] = None
         self._states: Dict[int, Any] = {}      # RAM checkpoints (fallback)
+        self._ckpt_plane = None                # lazy (see ckpt_plane)
+        self._study_fp_cache: Optional[str] = None
         self._rec: Dict[int, Dict[str, Any]] = {
             t.trial_id: {"status": "pending", "epochs_done": 0,
                          "epochs_spent": 0, "rung": -1, "rung_scores": {},
@@ -229,31 +231,76 @@ class TrialRuntime:
         self._status = "created"
 
     # --- checkpoint plumbing ------------------------------------------------
-    def _ckpt_path(self, trial_id) -> Optional[str]:
+    @property
+    def ckpt_plane(self):
+        """The study's checkpoint plane (analytics_zoo_tpu.ckpt), rooted at
+        ``logs_dir/trial_ckpts``. Every trial checkpoints into ONE shared
+        content-addressed blob store, so a rung of trials sharing leaves
+        (frozen embeddings, identical init) writes them once; per-trial
+        retention keeps the last 2 committed checkpoints (the newest plus
+        a fallback past a checksum mismatch). None without a logs_dir."""
         if not self.logs_dir:
             return None
-        d = os.path.join(self.logs_dir, "trial_ckpts")
-        os.makedirs(d, exist_ok=True)
-        return os.path.join(d, f"trial_{trial_id}.pkl")
+        if self._ckpt_plane is None:
+            from ...ckpt import CheckpointPlane
+            self._ckpt_plane = CheckpointPlane(
+                os.path.join(self.logs_dir, "trial_ckpts"),
+                keep_last_k=2, async_save=True, max_inflight=2)
+        return self._ckpt_plane
+
+    def _trial_ckpt_name(self, trial_id) -> str:
+        """Per-trial checkpoint namespace, scoped by the STUDY fingerprint:
+        logs_dir is commonly reused across studies (fixed /tmp defaults),
+        and without the scope a stale study's higher-step checkpoints
+        would shadow this study's in per-name retention. Blobs stay shared
+        across studies — dedup is content-addressed, not name-addressed."""
+        if self._study_fp_cache is None:
+            self._study_fp_cache = self._fingerprint()[:10]
+        return f"study-{self._study_fp_cache}/trial_{trial_id}"
 
     def _save_state(self, trial_id, state,
                     stash_on_fail: bool = True) -> Optional[str]:
-        """Durable checkpoint to disk when possible; RAM otherwise (some
-        model states — live estimator objects — don't pickle). Disk success
-        frees the RAM copy, so paused trials don't accumulate host memory.
+        """Durable checkpoint through the plane when possible; RAM
+        otherwise (some model states — live estimator objects — don't
+        pickle). Disk success frees the RAM copy, so paused trials don't
+        accumulate host memory. The plane's save is async (blob hashing +
+        IO drain on its writer thread) and atomic — a crash mid-write
+        leaves the previous committed checkpoint as the resume point.
         ``stash_on_fail=False`` makes the disk write purely best-effort
-        (used for completed trials, whose state already lives on the Trial)."""
+        (used for completed trials, whose state already lives on the
+        Trial)."""
         if state is None:
             return None
-        path = self._ckpt_path(trial_id)
-        if path is not None:
+        plane = self.ckpt_plane
+        if plane is not None:
             try:
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    pickle.dump(state, f)
-                os.replace(tmp, path)
-                self._states.pop(trial_id, None)
-                return path
+                # the skeleton pickle runs synchronously inside save(), so
+                # unpicklable states fail HERE and fall back to RAM. The
+                # RAM copy is stashed FIRST and released only from the
+                # writer's on_done callback — an async IO failure (disk
+                # full, permission) must leave the state recoverable, like
+                # the old inline-pickle path did
+                rec = self._rec[trial_id]
+                if stash_on_fail:
+                    with self._lock:
+                        self._states[trial_id] = state
+
+                def _written(err, tid=trial_id, st=state,
+                             keep=stash_on_fail):
+                    if err is None:
+                        with self._lock:
+                            # a newer stash may have replaced ours — only
+                            # release the exact state this save made durable
+                            if self._states.get(tid) is st:
+                                self._states.pop(tid, None)
+                    elif keep:
+                        logger.warning(
+                            "trial %s checkpoint write failed (%s); "
+                            "keeping the state in memory", tid, err)
+
+                return plane.save(state, rec["epochs_done"],
+                                  name=self._trial_ckpt_name(trial_id),
+                                  on_done=_written)
             except Exception as e:     # noqa: BLE001 — fall back to RAM
                 if stash_on_fail:
                     logger.warning("trial %s checkpoint not picklable (%s); "
@@ -267,13 +314,27 @@ class TrialRuntime:
         if state is not None:
             return state
         path = self._rec[trial_id]["ckpt"]
-        if path and os.path.exists(path):
-            try:
-                with open(path, "rb") as f:
-                    return pickle.load(f)
-            except Exception as e:      # noqa: BLE001
-                logger.warning("trial %s checkpoint unreadable (%s); "
-                               "restarting from scratch", trial_id, e)
+        if not path:
+            return None
+        try:
+            if os.path.isdir(path) or not os.path.exists(path):
+                # checkpoint-plane dir (manifest + blobs): load EXACTLY the
+                # recorded dir, digest-verified, after flushing pending
+                # writes. Never "newest step under this trial's name" —
+                # logs_dir is commonly reused across studies (the
+                # AutoEstimator default is a fixed /tmp path), and a stale
+                # higher-step checkpoint from a previous study would
+                # masquerade as this trial's future, silently skipping its
+                # remaining training.
+                if self._ckpt_plane is not None:
+                    self._ckpt_plane.flush()
+                from ...ckpt import load_checkpoint_dir
+                return load_checkpoint_dir(path)
+            with open(path, "rb") as f:        # legacy pickle checkpoint
+                return pickle.load(f)
+        except Exception as e:          # noqa: BLE001
+            logger.warning("trial %s checkpoint unreadable (%s); "
+                           "restarting from scratch", trial_id, e)
         return None
 
     # --- study manifest -----------------------------------------------------
@@ -631,6 +692,12 @@ class TrialRuntime:
             if unsub_compile is not None:
                 unsub_compile()
         self._finalize()
+        if self._ckpt_plane is not None:
+            # the manifest below records ckpt paths as durable facts; every
+            # queued trial checkpoint must be committed before it says so
+            # (this is also the SIGTERM grace-window flush: run() unwinds
+            # here on a preemption halt)
+            self._ckpt_plane.flush()
         self._wall_s = time.perf_counter() - t_start
         self._save_manifest(self._status)
         self._ev.emit("study_" + self._status, name=self.name,
@@ -797,6 +864,8 @@ class TrialRuntime:
             if self.compile_cache is not None else {})
         return {"study": self.name, "status": self._status,
                 "compile": compile_snap,
+                "ckpt": (self._ckpt_plane.stats.snapshot()
+                         if self._ckpt_plane is not None else {}),
                 "wall_s": round(self._wall_s, 3),
                 "max_t": self.max_t, "eta": self.bracket.eta,
                 "rungs": self.bracket.snapshot(),

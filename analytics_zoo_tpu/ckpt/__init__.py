@@ -1,0 +1,44 @@
+"""Checkpoint plane — async, atomic, content-addressed checkpointing.
+
+Every durable-state path in the stack used to ``pickle.dump`` the full
+weight blob synchronously: the training loop stalled for the whole write,
+a crash mid-write left a corrupt "latest" that ``find_latest_checkpoint``
+happily resumed from, and nothing was shared between the near-identical
+states an ASHA study or a periodic trigger produces. This package owns
+save/restore for the whole stack instead:
+
+* **Format** (:mod:`.format`): per-leaf blobs content-addressed by sha256
+  plus a JSON manifest (pytree skeleton digest, per-leaf digest/dtype/
+  shape, step, score). Legacy ``state.pkl`` checkpoints stay readable.
+* **Atomicity**: tmp dir → fsync → rename → COMMIT marker; the loader
+  skips uncommitted dirs and falls back past checksum mismatches, so a
+  SIGKILL mid-write can never corrupt resume.
+* **Async saves** (:class:`.plane.CheckpointPlane`): the loop pays only
+  the device→host snapshot; a writer thread hashes and writes behind
+  training, with a bounded in-flight window. Preemption flushes pending
+  writes inside the grace window.
+* **Dedup**: unchanged leaves across steps/trials are stored once;
+  ``keep_last_k``/``keep_best_k`` retention GCs by mark-and-sweep over
+  manifests, so shared blobs survive any delete.
+* **Encryption at rest** rides ``utils/crypto`` per blob (plaintext
+  digests keep dedup working on sealed stores).
+* **Serving hot-reload** (:class:`.watch.CheckpointWatcher`): watch a
+  checkpoint dir and swap same-shape weights into a live
+  ``InferenceModel`` with zero new compiles.
+
+Telemetry (:class:`.stats.CkptStats` — bytes written, dedup ratio, save
+latency hidden vs blocking) surfaces through ``data_pipeline_stats()``,
+serving ``/metrics`` and ``bench.py``'s checkpoint microbench.
+"""
+
+from .format import (is_committed, is_plane_dir, load_checkpoint_dir,
+                     read_manifest)
+from .plane import CheckpointPlane, parse_step
+from .stats import CkptStats
+from .watch import CheckpointWatcher
+
+__all__ = [
+    "CheckpointPlane", "CheckpointWatcher", "CkptStats",
+    "is_committed", "is_plane_dir", "load_checkpoint_dir", "parse_step",
+    "read_manifest",
+]

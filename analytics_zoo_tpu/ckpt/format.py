@@ -1,0 +1,287 @@
+"""Checkpoint wire format: per-leaf content-addressed blobs + JSON manifest.
+
+A committed checkpoint is a directory::
+
+    ckpt-<step>/
+        MANIFEST.json     # pytree metadata: step, per-leaf digest/dtype/shape
+        COMMIT            # commit marker — written LAST, after fsync+rename
+
+with the actual tensor bytes living in a shared, content-addressed blob
+store (``<root>/blobs/<sha256>[.enc]``, see :mod:`.store`). The state
+pytree is split into:
+
+* **array leaves** (every ``np.ndarray`` / ``jax.Array``) — one raw-bytes
+  blob each, addressed by the sha256 of the *plaintext* bytes, so leaves
+  unchanged across steps or shared across trials (an ASHA rung's frozen
+  embeddings) are stored once regardless of how many manifests reference
+  them;
+* the **skeleton** — the original tree with each array leaf replaced by a
+  positional :class:`_LeafRef`, pickled into one (usually tiny) blob.
+  Optimizer namedtuples, ``PartitionSpec``s, step counters and — for
+  serving checkpoints — the flax module itself ride in the skeleton, so
+  any state the old ``pickle.dump`` path accepted round-trips here too.
+
+Atomicity protocol (the loader's contract):
+
+1. blobs land via write-tmp → fsync → ``os.replace`` (atomic, idempotent);
+2. the manifest is written into a hidden tmp dir, fsynced, and the tmp
+   dir is renamed to ``ckpt-<step>``;
+3. the ``COMMIT`` marker is written (and fsynced) only after the rename.
+
+A crash anywhere before step 3 leaves either a ``.tmp-*`` dir or a
+``ckpt-<step>`` without ``COMMIT`` — both are skipped by the loader, which
+falls back to the previous committed checkpoint. Checksum verification on
+load (digest of the decrypted blob bytes vs the manifest) catches torn or
+bit-rotted blobs the same way.
+
+Encryption at rest rides ``utils/crypto`` per blob: digests address the
+plaintext (dedup still works), files hold the sealed bytes, and the
+``.enc`` filename suffix keeps plain and sealed stores from colliding.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+FORMAT = "zoo-ckpt-v1"
+MANIFEST_NAME = "MANIFEST.json"
+COMMIT_NAME = "COMMIT"
+BLOB_DIR = "blobs"
+
+
+class _LeafRef:
+    """Placeholder for an extracted array leaf (position in the manifest's
+    ``leaves`` list). Pickles to itself, so it survives the skeleton blob."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    def __reduce__(self):
+        return (_LeafRef, (self.idx,))
+
+
+def _pickler():
+    """cloudpickle when available (serving checkpoints carry flax modules),
+    stdlib pickle otherwise — matching InferenceModel's existing blobs."""
+    try:
+        import cloudpickle
+        return cloudpickle
+    except ImportError:             # pragma: no cover - image carries it
+        import pickle
+        return pickle
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype from its manifest name, including the ml_dtypes extension
+    types (bfloat16 & friends) numpy's constructor may not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def split_state(state) -> Tuple[bytes, List[np.ndarray]]:
+    """State pytree -> (pickled skeleton bytes, array leaves in ref order).
+
+    Cheap by design — no hashing, no copies beyond contiguity fixes — so
+    the async saver can run it synchronously on the training loop and hand
+    a frozen snapshot to the writer thread.
+    """
+    import jax
+
+    leaves: List[np.ndarray] = []
+
+    def repl(leaf):
+        if isinstance(leaf, jax.Array):
+            leaf = np.asarray(jax.device_get(leaf))
+        if isinstance(leaf, np.ndarray):
+            # copy() — not ascontiguousarray, which silently promotes 0-d
+            # to 1-d (optax step counters are 0-d). The copy is what makes
+            # "save() freezes the state" true: the async writer hashes and
+            # writes these leaves later, and the caller (or a resumed
+            # trial handed the same RAM object) may mutate the originals
+            # in place meanwhile — aliasing would commit a torn state
+            # whose digests validate.
+            leaves.append(leaf.copy())
+            return _LeafRef(len(leaves) - 1)
+        return leaf
+
+    skeleton = jax.tree_util.tree_map(repl, state)
+    return _pickler().dumps(skeleton), leaves
+
+
+def join_state(skeleton_bytes: bytes, leaves: List[np.ndarray]):
+    """Inverse of :func:`split_state`."""
+    import jax
+    import pickle
+    skeleton = pickle.loads(skeleton_bytes)     # cloudpickle emits pickle
+    return jax.tree_util.tree_map(
+        lambda l: leaves[l.idx] if isinstance(l, _LeafRef) else l,
+        skeleton, is_leaf=lambda x: isinstance(x, _LeafRef))
+
+
+def digest_of(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def leaf_record(arr: np.ndarray, digest: str) -> Dict[str, Any]:
+    return {"digest": digest, "dtype": str(arr.dtype),
+            "shape": list(arr.shape), "nbytes": int(arr.nbytes)}
+
+
+def decode_leaf(raw: bytes, rec: Dict[str, Any]) -> np.ndarray:
+    if digest_of(raw) != rec["digest"]:
+        raise ValueError(f"blob {rec['digest'][:12]} checksum mismatch")
+    # frombuffer over a bytearray copy: bytes-backed views are READ-ONLY,
+    # and the pickle path this format replaces returned writable arrays —
+    # fit_eval state consumers may update restored leaves in place
+    arr = np.frombuffer(bytearray(raw), dtype=_np_dtype(rec["dtype"]))
+    return arr.reshape(tuple(rec["shape"]))
+
+
+def build_manifest(step: int, skeleton_rec: Dict, leaf_recs: List[Dict],
+                   blob_dir_rel: str, encrypted: bool,
+                   score: Optional[float] = None,
+                   meta: Optional[Dict] = None) -> Dict:
+    return {"format": FORMAT, "step": int(step),
+            "created": round(time.time(), 3),
+            "score": None if score is None else float(score),
+            "encrypted": bool(encrypted),
+            "blob_dir": blob_dir_rel,
+            "skeleton": skeleton_rec, "leaves": leaf_recs,
+            "logical_bytes": skeleton_rec["nbytes"]
+            + sum(r["nbytes"] for r in leaf_recs),
+            "meta": meta or {}}
+
+
+# --- fsync helpers ----------------------------------------------------------
+def fsync_file(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:                 # pragma: no cover - non-POSIX
+        return
+    try:
+        os.fsync(fd)
+    except OSError:                 # pragma: no cover - e.g. NFS quirks
+        pass
+    finally:
+        os.close(fd)
+
+
+# --- directory-level readers ------------------------------------------------
+_STEP_RE = re.compile(r"(?:ckpt-|step_)?(\d+)$")
+
+
+def parse_step(dirname: str) -> Optional[int]:
+    """Step number of a versioned checkpoint dir name, None if not one."""
+    m = _STEP_RE.fullmatch(dirname)
+    return int(m.group(1)) if m else None
+
+
+def loadable_step_dirs(base: str, bare_ok: bool = False
+                       ) -> List[Tuple[int, str]]:
+    """The ONE scanner deciding which checkpoint dirs under ``base`` are
+    resume candidates — shared by ``CheckpointPlane._committed``,
+    ``CheckpointWatcher`` and ``find_latest_checkpoint``, so a format
+    tweak (new prefix, commit rule) cannot make them disagree.
+
+    Returns (step, path) sorted by step ascending. Plane dirs count only
+    when COMMITTED (manifest + COMMIT marker); non-plane dirs need a
+    legacy ``state.pkl`` unless ``bare_ok`` (the estimator scanner's
+    historical acceptance of bare step dirs from pre-plane layouts).
+    """
+    out: List[Tuple[int, str]] = []
+    if not os.path.isdir(base):
+        return out
+    for entry in os.listdir(base):
+        step = parse_step(entry)
+        if step is None:
+            continue
+        path = os.path.join(base, entry)
+        if not os.path.isdir(path):
+            continue
+        if is_plane_dir(path):
+            if not is_committed(path):
+                continue            # torn write: never a candidate
+        elif not bare_ok and not os.path.exists(
+                os.path.join(path, "state.pkl")):
+            continue
+        out.append((step, path))
+    out.sort()
+    return out
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    """A checkpoint-plane dir the loader may trust: manifest + COMMIT."""
+    return (os.path.exists(os.path.join(ckpt_dir, MANIFEST_NAME))
+            and os.path.exists(os.path.join(ckpt_dir, COMMIT_NAME)))
+
+
+def is_plane_dir(ckpt_dir: str) -> bool:
+    return os.path.exists(os.path.join(ckpt_dir, MANIFEST_NAME))
+
+
+def read_manifest(ckpt_dir: str) -> Dict:
+    with open(os.path.join(ckpt_dir, MANIFEST_NAME), encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("format") != FORMAT:
+        raise ValueError(f"{ckpt_dir}: unknown checkpoint format "
+                         f"{doc.get('format')!r}")
+    return doc
+
+
+def load_checkpoint_dir(ckpt_dir: str, passphrase: Optional[str] = None):
+    """Read one checkpoint directory back into its state pytree.
+
+    Handles both formats: a checkpoint-plane dir (manifest + blobs,
+    digest-verified leaf by leaf) and a legacy ``state.pkl`` dir — old
+    checkpoints written by the pickle path stay readable forever.
+    """
+    from .store import BlobStore
+
+    legacy = os.path.join(ckpt_dir, "state.pkl")
+    if not is_plane_dir(ckpt_dir):
+        if os.path.exists(legacy):
+            import pickle
+            with open(legacy, "rb") as f:
+                return pickle.load(f)
+        raise FileNotFoundError(f"{ckpt_dir}: no MANIFEST.json or state.pkl")
+    doc = read_manifest(ckpt_dir)
+    if not os.path.exists(os.path.join(ckpt_dir, COMMIT_NAME)):
+        raise ValueError(f"{ckpt_dir}: uncommitted checkpoint (no COMMIT)")
+    if doc["encrypted"] and passphrase is None:
+        raise ValueError(f"{ckpt_dir}: checkpoint is encrypted at rest; "
+                         "a passphrase is required")
+    store = BlobStore(os.path.normpath(
+        os.path.join(ckpt_dir, doc["blob_dir"])))
+    sk = doc["skeleton"]
+    raw = store.get(sk["digest"], encrypted=doc["encrypted"],
+                    passphrase=passphrase)
+    if digest_of(raw) != sk["digest"]:
+        raise ValueError(f"{ckpt_dir}: skeleton blob checksum mismatch")
+    leaves = [decode_leaf(
+        store.get(rec["digest"], encrypted=doc["encrypted"],
+                  passphrase=passphrase), rec)
+        for rec in doc["leaves"]]
+    return join_state(raw, leaves)

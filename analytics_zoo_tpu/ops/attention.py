@@ -319,7 +319,11 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         q_off = s_k - s_q
 
         def k_index(bh, qi, ki):
-            last = (q_off + (qi + 1) * block_q - 1) // block_k
+            # clamp at 0: with s_q > s_k (negative q_off) a fully-masked
+            # leading q block would otherwise compute a NEGATIVE last
+            # active block and issue a negative-index k/v DMA
+            last = jnp.maximum((q_off + (qi + 1) * block_q - 1) // block_k,
+                               0)
             return (bh, jnp.minimum(ki, last), 0)
     else:
         def k_index(bh, qi, ki):

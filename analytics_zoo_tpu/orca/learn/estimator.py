@@ -108,6 +108,42 @@ class TPUEstimator:
         # re-fit — the probe answer cannot change for the same
         # model/shapes, so pay it once
         self._fuse_probe_cache: Dict = {}
+        # checkpoint plane (analytics_zoo_tpu.ckpt): lazily bound to the
+        # first model_dir save_checkpoint/load_checkpoint touches
+        self._ckpt_plane = None
+
+    # --- checkpoint plane ---------------------------------------------------
+    def _ckpt(self, model_dir: str):
+        """The CheckpointPlane for ``model_dir`` (one per estimator; rebound
+        if a caller switches directories). Knobs ride ``config``:
+        ``ckpt_async`` (default True — the loop pays only the device→host
+        snapshot, a writer thread drains behind training),
+        ``ckpt_keep_last_k``/``ckpt_keep_best_k`` retention,
+        ``ckpt_passphrase`` (encrypted at rest via utils/crypto),
+        ``ckpt_max_inflight`` (back-to-back trigger window, default 2)."""
+        from ...ckpt import CheckpointPlane
+        if self._ckpt_plane is None or self._ckpt_plane.root != model_dir:
+            if self._ckpt_plane is not None:
+                self._ckpt_plane.close()
+            cfg = self.config
+            self._ckpt_plane = CheckpointPlane(
+                model_dir,
+                keep_last_k=cfg.get("ckpt_keep_last_k"),
+                keep_best_k=cfg.get("ckpt_keep_best_k"),
+                metric_mode=cfg.get("ckpt_metric_mode", "min"),
+                passphrase=cfg.get("ckpt_passphrase"),
+                async_save=bool(cfg.get("ckpt_async", True)),
+                max_inflight=int(cfg.get("ckpt_max_inflight", 2)),
+                fsync=bool(cfg.get("ckpt_fsync", True)))
+        return self._ckpt_plane
+
+    def flush_checkpoints(self, timeout: Optional[float] = None) -> bool:
+        """Drain pending async checkpoint writes (no-op without a plane).
+        fit() calls this on every exit path; the preemption handler calls
+        it explicitly so the write lands inside the grace window."""
+        if self._ckpt_plane is None:
+            return True
+        return self._ckpt_plane.flush(timeout)
 
     # --- pipeline observability ---------------------------------------------
     def data_pipeline_stats(self, reset: bool = False) -> Dict[str, Any]:
@@ -118,6 +154,10 @@ class TPUEstimator:
         history. Every future perf PR should look here first to see where
         epoch time goes."""
         snap = self._pipeline_stats.snapshot()
+        if self._ckpt_plane is not None:
+            # checkpoint-plane counters (bytes written, dedup ratio, save
+            # stall vs hidden write time) ride along like the compile ones
+            snap["ckpt"] = self._ckpt_plane.stats.snapshot()
         if self.engine.compile_cache is not None:
             # compile-plane counters ride along: compiles vs cache hits and
             # (estimated) compile seconds saved, cumulative for the cache
@@ -265,12 +305,29 @@ class TPUEstimator:
             fuse = 1
         epoch_stats = []
         watcher = PreemptionWatcher() if can_recover else None
-        with (watcher if watcher is not None else contextlib.nullcontext()):
-            return self._fit_loop(it, epochs, steps_per_epoch, batch_size,
-                                  feature_cols, label_cols, validation_data,
-                                  checkpoint_trigger, profile, verbose,
-                                  can_recover, retries_left, epoch_stats,
-                                  watcher, fuse)
+        try:
+            with (watcher if watcher is not None
+                  else contextlib.nullcontext()):
+                return self._fit_loop(it, epochs, steps_per_epoch,
+                                      batch_size, feature_cols, label_cols,
+                                      validation_data, checkpoint_trigger,
+                                      profile, verbose, can_recover,
+                                      retries_left, epoch_stats, watcher,
+                                      fuse)
+        finally:
+            # returning from fit() means every queued checkpoint is
+            # durable — resumers (AutoML pause/resume, a supervisor
+            # restart) read the dir right after. A failed async write
+            # gets one blocking retry; past that, log-and-continue (an
+            # exception here would mask the loop's own)
+            if not self.flush_checkpoints() and self.model_dir is not None:
+                try:
+                    self.save_checkpoint(self.model_dir, blocking=True)
+                except Exception as save_err:       # noqa: BLE001
+                    logger.error(
+                        "final checkpoint could not be written (%s); the "
+                        "newest restore point predates this fit's last "
+                        "trigger", save_err)
 
     def _choose_fuse(self, it, steps_per_epoch, trigger=None) -> int:
         """Pick the scan-fusion factor for this fit. Small-model steps are
@@ -437,13 +494,15 @@ class TPUEstimator:
                 if not can_recover or retries_left <= 0:
                     raise
                 retries_left -= 1
-                path, step = learn_utils.find_latest_checkpoint(
-                    self.model_dir)
+                # load_checkpoint flushes pending async writes first and
+                # returns the path it ACTUALLY restored (logging a scanner
+                # guess here could name a different dir than the one the
+                # plane's fallback logic lands on)
+                path = self.load_checkpoint(self.model_dir)
                 logger.warning(
-                    "training failed at epoch %d (%s: %s); restoring "
-                    "checkpoint %s and retrying (%d retries left)",
+                    "training failed at epoch %d (%s: %s); restored "
+                    "checkpoint %s, retrying (%d retries left)",
                     ep + 1, type(e).__name__, e, path, retries_left)
-                self.load_checkpoint(self.model_dir)
                 self._trainer_state.iteration = self.engine.step
                 continue                 # re-run the failed epoch
             if watcher is not None and watcher.triggered:
@@ -451,8 +510,22 @@ class TPUEstimator:
                 # checkpoint IMMEDIATELY — the grace window is short, and
                 # validation/logging must not stand between the notice and
                 # the restore point. The epoch is partial; flag it so
-                # consumers don't read its stats as a full epoch.
+                # consumers don't read its stats as a full epoch. Pending
+                # async writes are flushed too: the host may die right
+                # after the grace window, so queued != durable is not
+                # acceptable here.
                 self.save_checkpoint(self.model_dir)
+                if not self.flush_checkpoints():
+                    # the async write failed (disk full?): one blocking
+                    # retry — a stale restore point on preemption loses a
+                    # whole trigger interval of work
+                    try:
+                        self.save_checkpoint(self.model_dir, blocking=True)
+                    except Exception as save_err:   # noqa: BLE001
+                        logger.error(
+                            "preemption checkpoint could not be written "
+                            "(%s); resume will use the previous restore "
+                            "point", save_err)
                 stats["preempted"] = True
                 stats["partial_epoch"] = True
                 epoch_stats.append(stats)
@@ -751,20 +824,37 @@ class TPUEstimator:
         self.engine.set_state(state)
         return self
 
-    def save_checkpoint(self, model_dir: str):
-        step = self.engine.step
-        path = os.path.join(model_dir, f"ckpt-{step}")
-        os.makedirs(path, exist_ok=True)
-        self.save(os.path.join(path, "state.pkl"))
-        logger.info("checkpoint saved: %s", path)
+    def save_checkpoint(self, model_dir: str, blocking: bool = False):
+        """Checkpoint through the plane (analytics_zoo_tpu.ckpt): per-leaf
+        content-addressed blobs + manifest, committed atomically. By
+        default the write drains on the plane's writer thread — the loop
+        pays only the device→host snapshot; ``blocking=True`` (or config
+        ``ckpt_async: False``) waits for the committed write."""
+        plane = self._ckpt(model_dir)
+        path = plane.save(self.engine.get_state(), self.engine.step,
+                          score=self._trainer_state.score,
+                          blocking=blocking)
+        logger.info("checkpoint %s: %s",
+                    "saved" if blocking else "queued", path)
         return path
 
     def load_checkpoint(self, model_dir: str):
-        path, step = learn_utils.find_latest_checkpoint(model_dir)
-        if path is None:
+        """Restore the newest *committed* checkpoint: pending async writes
+        are flushed first, uncommitted/corrupt dirs are skipped with
+        fallback to the previous good one, and legacy ``state.pkl``
+        checkpoints load unchanged."""
+        plane = self._ckpt(model_dir)
+        try:
+            path, state = plane.restore()
+        except FileNotFoundError:
             raise FileNotFoundError(f"no checkpoint under {model_dir}")
-        self.load(os.path.join(path, "state.pkl"))
+        if self.engine.params is None:
+            self.engine.params = state["params"]
+        self.engine.set_state(state)
         return path
 
     def shutdown(self):
-        pass
+        if self._ckpt_plane is not None:
+            self._ckpt_plane.flush()
+            self._ckpt_plane.close()
+            self._ckpt_plane = None

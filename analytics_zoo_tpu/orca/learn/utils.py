@@ -208,7 +208,13 @@ def estimate_step_compute_s(jitted, args, devices) -> Optional[float]:
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0) or 0.0)
-        peak = sum(peak_bf16_flops(d) for d in devices)
+        # cost_analysis reports the PER-DEVICE program (post-SPMD
+        # partitioning), so the denominator is ONE device's peak, not the
+        # summed mesh peak — summing under-estimated compute time by the
+        # device count, mis-classifying compute-dominated models as
+        # dispatch-bound, scan-fusing them and coarsening their
+        # checkpoint/preemption cadence
+        peak = max((peak_bf16_flops(d) for d in devices), default=0.0)
         if flops > 0 and peak > 0:
             return flops / (ASSUMED_TRAIN_MFU * peak)
     except Exception:
@@ -545,17 +551,16 @@ def update_predict_xshards(xshards: HostXShards,
 
 def find_latest_checkpoint(model_dir: str, model_type: str = "tpu"):
     """Locate the newest versioned checkpoint under model_dir (reference:
-    orca/learn/utils.py:24-69 scans for model.<iter> files; here orbax step
-    dirs)."""
-    import os
-    import re
-    if not os.path.isdir(model_dir):
+    orca/learn/utils.py:24-69 scans for model.<iter> files; here step
+    dirs). One scanner — ``ckpt.format.loadable_step_dirs`` — decides
+    candidacy for this, the plane and the hot-reload watcher: plane dirs
+    count only when COMMITTED (a manifest without its COMMIT marker is a
+    torn write and must never be the resume point); ``bare_ok`` keeps
+    this function's historical acceptance of bare step dirs from
+    pre-plane layouts."""
+    from ...ckpt.format import loadable_step_dirs
+    dirs = loadable_step_dirs(model_dir, bare_ok=True)
+    if not dirs:
         return None, None
-    best = (None, -1)
-    for name in os.listdir(model_dir):
-        m = re.fullmatch(r"(?:ckpt-|step_)?(\d+)", name)
-        if m and os.path.isdir(os.path.join(model_dir, name)):
-            v = int(m.group(1))
-            if v > best[1]:
-                best = (os.path.join(model_dir, name), v)
-    return best if best[0] else (None, None)
+    step, path = dirs[-1]
+    return path, step

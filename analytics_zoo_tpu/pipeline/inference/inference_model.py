@@ -93,6 +93,13 @@ class InferenceModel:
         # live in the shared ExecutableCache (or the jit wrapper's cache)
         self._cache: Dict[Tuple, Callable] = {}
         self._lock = threading.Lock()
+        # checkpoint-plane hot-reload (enable_hot_reload): watcher thread +
+        # counters surfaced via ckpt_stats() / serving metrics()["ckpt"]
+        self._watcher = None
+        self._loaded_step = None     # step load_checkpoint bootstrapped from
+        self._ckpt_counters: Dict = {"hot_reloads": 0, "full_reloads": 0,
+                                     "reload_skips": 0,
+                                     "last_reload_step": None}
         # call_tf-backed loaders set this: jax2tf.call_tf under jit requires
         # the TF function to be XLA-compilable, which frozen graphs with
         # NMS/lookup ops (TFNet's main use case) are not — those apply_fns
@@ -249,13 +256,157 @@ class InferenceModel:
     def load(self, model_path: str, weight_path: Optional[str] = None
              ) -> "InferenceModel":
         """Load an estimator checkpoint pickle (reference ``load`` loads
-        BigDL models, inference_model.py:40)."""
+        BigDL models, inference_model.py:40) or a checkpoint-plane
+        directory/root (``analytics_zoo_tpu.ckpt`` manifest + blobs)."""
+        import os
+        if os.path.isdir(model_path):
+            return self.load_checkpoint(model_path)
         with open(model_path, "rb") as f:
             return self._load_blob(f.read())
 
     def save(self, module, path: str):
         with open(path, "wb") as f:
             f.write(self._dump_blob(module))
+
+    # --- checkpoint plane (manifest + content-addressed blobs) --------------
+    def _state_doc(self, module) -> dict:
+        import jax
+        return {"module": module,
+                "state": {"params": jax.device_get(
+                              self._variables["params"]),
+                          "extra_vars": {
+                              k: jax.device_get(v)
+                              for k, v in self._variables.items()
+                              if k != "params"}}}
+
+    def save_checkpoint(self, module, root: str, step: int = 0,
+                        passphrase: Optional[str] = None) -> str:
+        """Write a committed checkpoint-plane artifact (atomic, per-leaf
+        content-addressed, optionally encrypted at rest) under ``root`` —
+        the serving twin of ``TPUEstimator.save_checkpoint``, and the
+        producer side of :meth:`enable_hot_reload`."""
+        from ...ckpt import CheckpointPlane
+        plane = CheckpointPlane(root, passphrase=passphrase,
+                                async_save=False)
+        return plane.save(self._state_doc(module), step, blocking=True)
+
+    @staticmethod
+    def _state_to_variables(state):
+        """Checkpoint state → serving variables. Accepts both schemas:
+        serving docs ({"module", "state": {params, extra_vars}}) and raw
+        estimator states ({params, extra_vars, opt_state, ...})."""
+        inner = state.get("state", state)
+        variables = {"params": inner["params"],
+                     **(inner.get("extra_vars") or {})}
+        return variables, state.get("module")
+
+    def load_checkpoint(self, root: str, step: Optional[int] = None,
+                        passphrase: Optional[str] = None
+                        ) -> "InferenceModel":
+        """Load from a checkpoint-plane root (newest committed checkpoint;
+        uncommitted/corrupt dirs are skipped) or a single checkpoint dir.
+        Estimator checkpoints work too when a module was loaded before
+        (weights-only adoption); serving checkpoints carry their module."""
+        import os
+
+        from ...ckpt import CheckpointPlane, is_plane_dir, \
+            load_checkpoint_dir
+        if is_plane_dir(root) or os.path.exists(
+                os.path.join(root, "state.pkl")):
+            path = root                                     # one ckpt dir
+            state = load_checkpoint_dir(root, passphrase)
+        else:
+            path, state = CheckpointPlane(
+                root, passphrase=passphrase).restore(step=step)
+        from ...ckpt import parse_step
+        self._loaded_step = parse_step(os.path.basename(path))
+        variables, module = self._state_to_variables(state)
+        if module is None:
+            if self._apply_fn is None:
+                raise ValueError(
+                    f"{root}: estimator checkpoint has no module; load a "
+                    "model first (load_jax) for weights-only adoption")
+            import jax
+            self._variables = jax.device_put(variables, self._repl)
+            self._reset_executables()
+            return self
+        return self.load_jax(module, variables)
+
+    # --- serving hot-reload -------------------------------------------------
+    def enable_hot_reload(self, root: str, poll_s: float = 2.0,
+                          passphrase: Optional[str] = None,
+                          start_at: Optional[int] = None):
+        """Watch ``root`` for newly COMMITTED checkpoints and swap the
+        weights into the live model. Same-shape states swap without
+        touching the compiled executables (the warmed buckets and the
+        compile plane's cached executable are reused — zero new compiles;
+        in-flight batches finish on the old weights, the next predict uses
+        the new ones). A shape/structure mismatch falls back to a full
+        reload when the checkpoint carries its module, else it is skipped.
+        Returns the :class:`~analytics_zoo_tpu.ckpt.CheckpointWatcher`
+        (``poll_now()`` forces a synchronous check). ``start_at`` skips
+        steps <= it; the default is the step ``load_checkpoint`` loaded
+        this model from, so a server bootstrapped from the watched dir
+        does not re-read and re-stage the checkpoint it already serves."""
+        from ...ckpt import CheckpointWatcher
+        self.disable_hot_reload()
+        if start_at is None:
+            start_at = getattr(self, "_loaded_step", None)
+        self._watcher = CheckpointWatcher(
+            root, self._hot_swap, poll_s=poll_s, passphrase=passphrase,
+            start_at=start_at)
+        self._watcher.start()
+        return self._watcher
+
+    def disable_hot_reload(self):
+        w = getattr(self, "_watcher", None)
+        if w is not None:
+            w.stop()
+            self._watcher = None
+
+    def _hot_swap(self, path: str, state, step: int):
+        import jax
+        variables, module = self._state_to_variables(state)
+
+        def sig(tree):
+            return jax.tree_util.tree_map(
+                lambda l: (getattr(l, "shape", None),
+                           str(getattr(l, "dtype", type(l)))), tree)
+
+        # shape/dtype are attributes on the live device arrays — no
+        # device_get: a D2H copy of the full weight tree per rollout just
+        # to read metadata would be a multi-GB transfer on big models
+        same = (self._variables is not None
+                and sig(variables) == sig(self._variables))
+        if same:
+            # weights-only swap: executables are keyed on program + input
+            # shapes, both unchanged — no reset, no recompile
+            self._variables = jax.device_put(variables, self._repl)
+            self._ckpt_counters["hot_reloads"] += 1
+            self._ckpt_counters["last_reload_step"] = int(step)
+            self._loaded_step = int(step)
+            logger.info("hot-reloaded weights from %s (step %d, "
+                        "0 new compiles)", path, step)
+        elif module is not None:
+            self.load_jax(module, variables)
+            self._ckpt_counters["hot_reloads"] += 1
+            self._ckpt_counters["full_reloads"] += 1
+            self._ckpt_counters["last_reload_step"] = int(step)
+            self._loaded_step = int(step)
+            logger.warning("hot-reload of %s changed the model structure; "
+                           "executables reset (buckets recompile)", path)
+        else:
+            self._ckpt_counters["reload_skips"] += 1
+            logger.warning("hot-reload skipped: %s does not match the "
+                           "served model's structure and carries no "
+                           "module", path)
+
+    def ckpt_stats(self) -> Dict:
+        """Hot-reload counters for the serving metrics surface (empty until
+        the first reload attempt, so metrics() can omit the section)."""
+        return {k: v for k, v in self._ckpt_counters.items()
+                if v is not None} if any(
+            v for v in self._ckpt_counters.values()) else {}
 
     def save_encrypted(self, module, path: str, passphrase: str):
         """Encrypted checkpoint at rest (the TPU-native analogue of the

@@ -193,6 +193,13 @@ class ClusterServing:
             snap = self.model.compile_stats()
             if snap:
                 out["compile"] = snap
+        if hasattr(self.model, "ckpt_stats"):
+            # checkpoint-plane hot-reload counters (weights swapped into
+            # the live model; full_reloads > 0 means a structure change
+            # forced bucket recompiles). Empty until the first reload.
+            snap = self.model.ckpt_stats()
+            if snap:
+                out["ckpt"] = snap
         return out
 
     def reset_metrics(self):

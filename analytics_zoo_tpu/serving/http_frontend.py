@@ -139,12 +139,15 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
             return web.json_response(
                 {"error": "please post a content like secret=xxx&salt=yyy"},
                 status=400)
-        app["model_secret"] = form["secret"]
-        app["model_salt"] = form["salt"]
+        # aiohttp forbids assigning new Application keys after startup —
+        # mutate the dict registered before run_app instead of app["..."]
+        request.app["model_secure"].update(secret=form["secret"],
+                                           salt=form["salt"])
         return web.Response(text="model secured secret and salt succeed "
                                  "to put in app state")
 
     app = web.Application(middlewares=[auth_middleware])
+    app["model_secure"] = {}        # mutable holder, registered pre-startup
     app.router.add_get("/", index)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/predict", predict)

@@ -58,4 +58,37 @@ keys = ("assemble_MBps", "h2d_MBps", "h2d_bytes", "lanes",
 print("TRANSFER_PLANE=" + json.dumps(
     {k: snap[k] for k in keys if k in snap}))
 EOF
+# checkpoint-plane snapshot: async save latency (on-loop stall vs hidden
+# write) + dedup ratio from a tiny fit checkpointing through the plane
+# (never affects the exit code)
+env JAX_PLATFORMS=cpu python - <<'EOF' 2>/dev/null || true
+import json
+import tempfile
+import numpy as np
+import flax.linen as nn
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+from analytics_zoo_tpu.orca.learn.trigger import SeveralIteration
+
+init_orca_context("local")
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(x)[:, 0]
+
+rng = np.random.RandomState(0)
+with tempfile.TemporaryDirectory() as d:
+    est = TPUEstimator(M(), loss="mse", optimizer="adam", model_dir=d,
+                       config={"steps_per_dispatch": 1})
+    est.fit({"x": rng.rand(256, 8).astype(np.float32),
+             "y": rng.rand(256).astype(np.float32)},
+            epochs=2, batch_size=32,
+            checkpoint_trigger=SeveralIteration(4), verbose=False)
+    snap = est.data_pipeline_stats().get("ckpt", {})
+    est.shutdown()
+keys = ("saves", "stall_s", "hidden_s", "write_s", "stall_frac",
+        "dedup_ratio", "bytes_written", "bytes_deduped")
+print("CKPT_PLANE=" + json.dumps({k: snap[k] for k in keys if k in snap}))
+EOF
 exit $rc

@@ -1,0 +1,439 @@
+"""Checkpoint plane (analytics_zoo_tpu.ckpt): async atomic saves,
+content-addressed dedup + GC, crash-injection fallback, encryption at
+rest, serving hot-reload with zero new compiles, legacy state.pkl reads.
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ckpt import (CheckpointPlane, CheckpointWatcher,
+                                    is_committed, load_checkpoint_dir,
+                                    read_manifest)
+from analytics_zoo_tpu.ckpt import format as ckpt_fmt
+from analytics_zoo_tpu.orca.learn.estimator import Estimator
+from analytics_zoo_tpu.orca.learn.trigger import SeveralIteration
+from analytics_zoo_tpu.orca.learn.utils import find_latest_checkpoint
+
+
+def _linear_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+         + 0.1 * rng.randn(n).astype(np.float32))
+    return x, y
+
+
+def _linear_model(_cfg=None):
+    import flax.linen as nn
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)[:, 0]
+
+    return Lin()
+
+
+def _tree_equal(a, b):
+    import jax
+    la, sa = jax.tree_util.tree_flatten(a)
+    lb, sb = jax.tree_util.tree_flatten(b)
+    if sa != sb or len(la) != len(lb):
+        return False
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _state():
+    """A training-state-shaped pytree with shared + distinct leaves."""
+    rng = np.random.RandomState(7)
+    emb = rng.rand(64, 16).astype(np.float32)
+    return {"params": {"emb": emb, "w": rng.rand(16, 4).astype(np.float32)},
+            "extra_vars": {},
+            "opt_state": (np.int32(3), {"mu": np.zeros((16, 4), np.float32)}),
+            "step": 12, "tp_specs": None}
+
+
+# --- fit-path bit-identity --------------------------------------------------
+def test_fit_save_restore_bit_identical(orca_context, tmp_path):
+    """Resumed training state must be bit-identical to the blocking-pickle
+    path: async plane save through fit == the state pickle.dump would have
+    written, leaf for leaf."""
+    x, y = _linear_data()
+    est = Estimator.from_keras(_linear_model, loss="mse",
+                               model_dir=str(tmp_path / "plane"))
+    est.fit({"x": x, "y": y}, epochs=2, batch_size=32,
+            checkpoint_trigger=SeveralIteration(4), verbose=False)
+    # reference: the exact engine state, round-tripped through pickle the
+    # way the old blocking path did
+    ref = pickle.loads(pickle.dumps(est.engine.get_state()))
+    ckpts = [d for d in os.listdir(tmp_path / "plane")
+             if d.startswith("ckpt-")]
+    assert ckpts and all(
+        is_committed(str(tmp_path / "plane" / d)) for d in ckpts)
+    est2 = Estimator.from_keras(_linear_model, loss="mse")
+    path = est2.load_checkpoint(str(tmp_path / "plane"))
+    assert path.endswith(f"ckpt-{est.engine.step}")
+    assert _tree_equal(est2.engine.get_state()["params"], ref["params"])
+    assert _tree_equal(est2.engine.get_state()["opt_state"],
+                       ref["opt_state"])
+    assert est2.engine.step == est.engine.step
+
+
+def test_async_save_identical_to_blocking(tmp_path):
+    """Same state, async vs blocking writer path → identical manifests
+    (same per-leaf digests, same logical bytes)."""
+    state = _state()
+    pa = CheckpointPlane(str(tmp_path / "a"), async_save=True)
+    pb = CheckpointPlane(str(tmp_path / "b"), async_save=False)
+    da = pa.save(state, 12)
+    pa.flush()
+    db = pb.save(state, 12)
+    ma, mb = read_manifest(da), read_manifest(db)
+    assert [l["digest"] for l in ma["leaves"]] == \
+        [l["digest"] for l in mb["leaves"]]
+    assert ma["skeleton"]["digest"] == mb["skeleton"]["digest"]
+    assert ma["logical_bytes"] == mb["logical_bytes"]
+    got = load_checkpoint_dir(da)
+    assert _tree_equal(got, load_checkpoint_dir(db))
+    # restored leaves are WRITABLE, like the pickle path they replace
+    # (frombuffer over raw bytes would hand back read-only views)
+    got["params"]["w"] += 1.0
+
+
+# --- crash injection --------------------------------------------------------
+def test_crash_mid_write_resumes_from_prior_commit(tmp_path, monkeypatch):
+    """A save killed before the COMMIT marker (or with a torn blob) must be
+    invisible: the loader lands on the last committed checkpoint."""
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    s1 = _state()
+    plane.save(s1, 1)
+
+    # crash #1: die right after the rename, before COMMIT
+    real_rename = os.rename
+
+    def dying_rename(src, dst):
+        real_rename(src, dst)
+        raise OSError("SIGKILL mid-commit")
+
+    monkeypatch.setattr(os, "rename", dying_rename)
+    s2 = _state()
+    s2["params"]["w"] = s2["params"]["w"] + 1.0
+    s2["step"] = 2
+    with pytest.raises(OSError):
+        plane.save(s2, 2)
+    monkeypatch.setattr(os, "rename", real_rename)
+    assert os.path.isdir(tmp_path / "ckpt-2")           # dir exists...
+    assert not is_committed(str(tmp_path / "ckpt-2"))   # ...but untrusted
+    path, got = plane.restore()
+    assert path.endswith("ckpt-1") and _tree_equal(got, s1)
+    # find_latest_checkpoint (the estimator's retry scanner) agrees
+    assert find_latest_checkpoint(str(tmp_path))[1] == 1
+
+    # crash #2: committed checkpoint whose blob rotted on disk
+    plane.save(s2, 2)
+    man = read_manifest(str(tmp_path / "ckpt-2"))
+    victim = next(l["digest"] for l in man["leaves"]
+                  if l["digest"] not in
+                  {x["digest"]
+                   for x in read_manifest(str(tmp_path / "ckpt-1"))["leaves"]})
+    blob = tmp_path / "blobs" / victim
+    raw = bytearray(blob.read_bytes())
+    raw[0] ^= 0xFF
+    blob.write_bytes(bytes(raw))
+    path, got = plane.restore()
+    assert path.endswith("ckpt-1") and _tree_equal(got, s1)
+    assert plane.stats.snapshot()["fallbacks"] >= 1
+
+
+# --- dedup + retention GC ---------------------------------------------------
+def test_dedup_refcounts_survive_gc(tmp_path):
+    """Retention deleting a checkpoint must not take blobs still referenced
+    by survivors (mark-and-sweep refcounting); only orphans are swept."""
+    plane = CheckpointPlane(str(tmp_path), keep_last_k=1, async_save=False,
+                            gc_grace_s=0.0)
+    s1 = _state()
+    plane.save(s1, 1)
+    only_in_1 = {l["digest"]
+                 for l in read_manifest(str(tmp_path / "ckpt-1"))["leaves"]}
+    s2 = _state()                       # same emb (shared), new w
+    s2["params"]["w"] = s2["params"]["w"] * 2.0
+    plane.save(s2, 2)                   # retention drops ckpt-1
+    assert not os.path.exists(tmp_path / "ckpt-1")
+    man2 = read_manifest(str(tmp_path / "ckpt-2"))
+    shared = {l["digest"] for l in man2["leaves"]} & only_in_1
+    assert shared                       # emb + mu deduped across saves
+    for d in shared:                    # ...and still on disk after GC
+        assert os.path.exists(tmp_path / "blobs" / d)
+    orphans = only_in_1 - {l["digest"] for l in man2["leaves"]}
+    for d in orphans:                   # ckpt-1-only blobs were swept
+        assert not os.path.exists(tmp_path / "blobs" / d)
+    _, got = plane.restore()
+    assert _tree_equal(got, s2)
+    snap = plane.stats.snapshot()
+    assert snap["blobs_deduped"] > 0 and snap["dedup_ratio"] > 0
+    assert snap["gc_blobs"] >= len(orphans) > 0
+
+
+def test_keep_best_k_without_scores_degrades_to_last_k(tmp_path):
+    """keep_best_k with UNSCORED checkpoints (fit without validation_data)
+    must not prune everything but the newest — unscored dirs fall back to
+    newest-k retention, preserving the corruption-fallback chain."""
+    plane = CheckpointPlane(str(tmp_path), keep_best_k=2, async_save=False,
+                            gc_min_interval_s=0.0)
+    s = _state()
+    for k in range(4):
+        plane.save(s, k)
+    dirs = sorted(int(d.split("-")[1]) for d in os.listdir(tmp_path)
+                  if d.startswith("ckpt-"))
+    assert dirs == [2, 3]
+    # scored checkpoints rank by score; best-2 survive a worse newcomer
+    plane2 = CheckpointPlane(str(tmp_path / "scored"), keep_best_k=2,
+                             async_save=False, gc_min_interval_s=0.0)
+    for k, score in enumerate([0.5, 0.1, 0.9, 0.3]):
+        plane2.save(s, k, score=score)
+    kept = sorted(int(d.split("-")[1])
+                  for d in os.listdir(tmp_path / "scored")
+                  if d.startswith("ckpt-"))
+    assert kept == [1, 3]               # the two lowest scores (mode=min)
+
+
+# --- encryption at rest -----------------------------------------------------
+def test_encrypted_round_trip(tmp_path):
+    plane = CheckpointPlane(str(tmp_path), passphrase="s3cret",
+                            async_save=False)
+    s = _state()
+    plane.save(s, 5)
+    _, got = plane.restore()
+    assert _tree_equal(got, s)
+    blobs = os.listdir(tmp_path / "blobs")
+    assert blobs and all(b.endswith(".enc") for b in blobs)
+    # plaintext weight bytes must not appear at rest
+    emb_bytes = s["params"]["emb"].tobytes()
+    for b in blobs:
+        assert emb_bytes not in (tmp_path / "blobs" / b).read_bytes()
+    # dedup works on sealed stores too (plaintext digests)
+    plane.save(s, 6)
+    assert plane.stats.snapshot()["blobs_deduped"] > 0
+    with pytest.raises(ValueError):
+        CheckpointPlane(str(tmp_path), passphrase="wrong").restore()
+    with pytest.raises(ValueError):     # missing passphrase fails loudly
+        CheckpointPlane(str(tmp_path)).restore()
+
+
+# --- legacy checkpoints -----------------------------------------------------
+def test_legacy_state_pkl_still_loads(orca_context, tmp_path):
+    """Pre-plane checkpoints (ckpt-<n>/state.pkl pickles) must stay
+    readable through the same load_checkpoint entry point."""
+    x, y = _linear_data()
+    est = Estimator.from_keras(_linear_model, loss="mse")
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, verbose=False)
+    legacy = tmp_path / f"ckpt-{est.engine.step}"
+    os.makedirs(legacy)
+    with open(legacy / "state.pkl", "wb") as f:
+        pickle.dump(est.engine.get_state(), f)
+    est2 = Estimator.from_keras(_linear_model, loss="mse")
+    path = est2.load_checkpoint(str(tmp_path))
+    assert path == str(legacy)
+    assert _tree_equal(est2.engine.get_state()["params"],
+                       est.engine.get_state()["params"])
+    # and a NEWER plane checkpoint wins over the legacy one
+    est.engine.step += 1
+    est.save_checkpoint(str(tmp_path), blocking=True)
+    est3 = Estimator.from_keras(_linear_model, loss="mse")
+    assert est3.load_checkpoint(str(tmp_path)).endswith(
+        f"ckpt-{est.engine.step}")
+
+
+# --- serving hot-reload -----------------------------------------------------
+def test_serving_hot_reload_zero_new_compiles(orca_context, tmp_path):
+    """A same-shape checkpoint swap must serve the new weights WITHOUT
+    recompiling: compile-plane counters frozen, outputs changed."""
+    import jax
+    from analytics_zoo_tpu.compile import compile_stats
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    module = _linear_model()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4), np.float32))
+    model = InferenceModel()
+    model.load_jax(module, variables)
+    model.save_checkpoint(module, str(tmp_path), step=1)
+    probe = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    out1 = model.predict(probe)                     # compiles the bucket
+
+    new_vars = jax.tree_util.tree_map(lambda a: np.asarray(a) + 1.0,
+                                      jax.device_get(variables))
+    model2 = InferenceModel()
+    model2.load_jax(module, new_vars)
+    model2.save_checkpoint(module, str(tmp_path), step=2)
+
+    watcher = model.enable_hot_reload(str(tmp_path), poll_s=60)
+    before = compile_stats()
+    assert watcher.poll_now()                       # synchronous swap
+    out2 = model.predict(probe)
+    after = compile_stats()
+    assert after.get("compiles", 0) == before.get("compiles", 0), \
+        "hot reload must not trigger XLA compilation"
+    assert not np.allclose(out1, out2)              # new weights served
+    np.testing.assert_allclose(out2, out1 + probe.sum(-1) + 1.0, rtol=1e-5)
+    snap = model.ckpt_stats()
+    assert snap["hot_reloads"] == 1 and snap["full_reloads"] == 0
+    assert snap["last_reload_step"] == 2
+    model.disable_hot_reload()
+
+    # a server bootstrapped FROM the watched dir must not re-reload the
+    # checkpoint it already serves on the first poll
+    model3 = InferenceModel()
+    model3.load_checkpoint(str(tmp_path))
+    w3 = model3.enable_hot_reload(str(tmp_path), poll_s=60)
+    assert not w3.poll_now()
+    assert model3.ckpt_stats() == {}        # no reload ever happened
+    model3.disable_hot_reload()
+
+
+def test_hot_reload_from_estimator_checkpoint(orca_context, tmp_path):
+    """Serving watches a TRAINING model_dir: estimator-schema checkpoints
+    (params/extra_vars, no module) hot-swap into the served model."""
+    import jax
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    x, y = _linear_data()
+    est = Estimator.from_keras(_linear_model, loss="mse",
+                               model_dir=str(tmp_path))
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, verbose=False)
+    est.save_checkpoint(str(tmp_path), blocking=True)
+
+    model = InferenceModel()
+    module = _linear_model()
+    model.load_jax(module, module.init(jax.random.PRNGKey(1),
+                                       np.zeros((1, 4), np.float32)))
+    w = model.enable_hot_reload(str(tmp_path), poll_s=60)
+    assert w.poll_now()
+    got = model.predict(x[:8])
+    want = est.predict({"x": x[:8]}, batch_size=8)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    model.disable_hot_reload()
+
+
+# --- trial runtime ----------------------------------------------------------
+def test_trial_runtime_checkpoints_through_plane(orca_context, tmp_path):
+    """TrialRuntime durable trial states ride the plane: committed dirs,
+    shared blob store across trials, round-trip via _load_state."""
+    from analytics_zoo_tpu.automl.scheduler.runtime import TrialRuntime
+
+    class Trial:
+        def __init__(self, tid):
+            self.trial_id = tid
+            self.config = {"lr": 0.1 * (tid + 1)}
+            self.metric_value = None
+            self.metrics = {}
+            self.duration_s = 0.0
+
+    trials = [Trial(0), Trial(1)]
+    rt = TrialRuntime(trials, model_builder=lambda cfg, mesh: None,
+                      data=None, logs_dir=str(tmp_path), max_t=4)
+    state = _state()
+    p0 = rt._save_state(0, state)
+    # shared leaves across trials are written once into the shared store
+    s2 = dict(state, step=99)
+    p1 = rt._save_state(1, s2)
+    # _finish_trial records the returned path; mirror that here
+    rt._rec[0]["ckpt"], rt._rec[1]["ckpt"] = p0, p1
+    rt.ckpt_plane.flush()
+    assert p0 and p0 != p1
+    assert is_committed(p0) and is_committed(p1)
+    assert os.path.isdir(tmp_path / "trial_ckpts" / "blobs")
+    assert rt.ckpt_plane.stats.snapshot()["blobs_deduped"] > 0
+    assert _tree_equal(rt._load_state(0), state)
+    assert _tree_equal(rt._load_state(1), s2)
+    assert rt.summary()["ckpt"]["saves"] == 2
+    # unpicklable states keep the RAM fallback
+    bad = {"fn": lambda: None, "w": np.ones(3)}
+    try:
+        import cloudpickle  # noqa: F401 — lambdas pickle fine with it
+        has_cp = True
+    except ImportError:
+        has_cp = False
+    if not has_cp:
+        assert rt._save_state(0, bad) is None
+        assert rt._load_state(0)["w"].sum() == 3
+    rt.ckpt_plane.close()
+
+    # an async WRITER failure (disk full mid-blob) must keep the state
+    # recoverable from the RAM fallback — it is released only after the
+    # write commits, not at enqueue time
+    rt2 = TrialRuntime([Trial(0)], model_builder=lambda cfg, mesh: None,
+                       data=None, logs_dir=str(tmp_path / "rt2"), max_t=4)
+    def _boom(*a, **k):
+        raise OSError("disk full")
+    rt2.ckpt_plane.store.put = _boom
+    p = rt2._save_state(0, state)
+    rt2.ckpt_plane.flush()
+    rt2._rec[0]["ckpt"] = p
+    assert rt2.ckpt_plane.stats.snapshot()["errors"] == 1
+    assert _tree_equal(rt2._load_state(0), state)       # RAM copy survives
+    rt2.ckpt_plane.close()
+
+
+# --- async back-pressure / flush -------------------------------------------
+def test_async_window_and_flush(tmp_path):
+    """Back-to-back saves respect the bounded in-flight window and flush()
+    drains everything (the preemption grace-window contract)."""
+    plane = CheckpointPlane(str(tmp_path), max_inflight=2)
+    s = _state()
+    for step in range(6):
+        s = dict(s, step=step)
+        s["params"] = dict(s["params"],
+                           w=np.full((16, 4), float(step), np.float32))
+        plane.save(s, step)
+    assert plane.flush(timeout=30)
+    steps = sorted(int(d.split("-")[1]) for d in os.listdir(tmp_path)
+                   if d.startswith("ckpt-"))
+    assert steps == list(range(6))
+    assert all(is_committed(str(tmp_path / f"ckpt-{k}")) for k in steps)
+    _, got = plane.restore()
+    assert float(got["params"]["w"][0, 0]) == 5.0
+    snap = plane.stats.snapshot()
+    assert snap["saves"] == 6 and snap["errors"] == 0
+    # the writer hid its work: on-loop stall exists but is a fraction of
+    # total save work (exact ratio is the bench's job, not the test's)
+    assert snap["stall_s"] > 0 and snap["hidden_s"] > 0
+    plane.close()
+
+
+def test_uncommitted_dirs_invisible_to_watcher(tmp_path):
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    plane.save(_state(), 1)
+    os.makedirs(tmp_path / "ckpt-2")
+    with open(tmp_path / "ckpt-2" / ckpt_fmt.MANIFEST_NAME, "w") as f:
+        json.dump({"format": ckpt_fmt.FORMAT}, f)   # torn write, no COMMIT
+    seen = []
+    w = CheckpointWatcher(str(tmp_path),
+                          lambda p, st, step: seen.append(step), poll_s=60)
+    assert w.poll_now() and seen == [1]
+    assert not w.poll_now()                         # nothing newer committed
+
+
+def test_watcher_skips_step_its_consumer_rejects(tmp_path):
+    """A checkpoint the CALLBACK cannot swap must be skipped, not re-read
+    and re-failed on every poll (unreadable checkpoints still retry)."""
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    plane.save(_state(), 1)
+    calls = []
+
+    def reject(path, state, step):
+        calls.append(step)
+        raise RuntimeError("incompatible module")
+
+    w = CheckpointWatcher(str(tmp_path), reject, poll_s=60)
+    assert not w.poll_now()
+    assert calls == [1] and w.last_step == 1        # consumed, skipped
+    assert not w.poll_now()
+    assert calls == [1]                             # NOT re-delivered
+    plane.save(_state(), 2)                         # a newer one still lands
+    assert not w.poll_now() and calls == [1, 2]

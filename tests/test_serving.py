@@ -531,7 +531,8 @@ def test_frontend_auth_and_sparse(orca_context):
                                        headers=hdr)
                 return (r0.status, r1.status, r2.status, r3.status,
                         r4.status, preds, r5.status,
-                        app["model_secret"], app["model_salt"])
+                        app["model_secure"]["secret"],
+                        app["model_secure"]["salt"])
 
         (s0, s1, s2, s3, s4, preds, s5, sec, salt) = \
             asyncio.new_event_loop().run_until_complete(run())
