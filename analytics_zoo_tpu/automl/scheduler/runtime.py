@@ -177,8 +177,9 @@ class TrialRuntime:
                  stop_score: Optional[float] = None,
                  devices: Optional[List] = None,
                  on_trial_done: Optional[Callable] = None,
-                 compile_cache=None):
+                 compile_cache=None, retry_policy=None):
         from ...compile import resolve_cache
+        from ...resilience.retry import RetryPolicy
         self.trials = trials
         self.model_builder = model_builder
         # the host-level executable cache every trial compiles through:
@@ -199,6 +200,16 @@ class TrialRuntime:
         self.stop_score = stop_score
         self.max_trial_retries = int(max_trial_retries)
         self.retry_backoff_s = float(retry_backoff_s)
+        # trial retry backoff rides the shared resilience RetryPolicy (the
+        # same exponential schedule the old hand-rolled 2**n loop computed;
+        # jitter 0 keeps study replays deterministic). The runtime drives
+        # the schedule itself — delay_for(attempt) — because a failed trial
+        # is re-queued, not re-invoked in place.
+        self.retry_policy = retry_policy if retry_policy is not None else \
+            RetryPolicy(max_attempts=self.max_trial_retries + 1,
+                        base_delay_s=self.retry_backoff_s,
+                        max_delay_s=300.0, jitter_frac=0.0,
+                        name="trial.retry")
         self.logs_dir = logs_dir
         self.name = name
         self.on_trial_done = on_trial_done
@@ -639,7 +650,7 @@ class TrialRuntime:
         rec["retries"] += 1
         trial.retries = rec["retries"]
         if rec["retries"] <= self.max_trial_retries:
-            backoff = self.retry_backoff_s * (2 ** (rec["retries"] - 1))
+            backoff = self.retry_policy.delay_for(rec["retries"])
             self._counters["retries"] += 1
             self._ev.emit("trial_retry", trial=trial.trial_id,
                           attempt=rec["retries"], backoff_s=backoff,
@@ -862,7 +873,9 @@ class TrialRuntime:
         compile_snap = (
             self.compile_cache.stats.delta_since(self._compile_base)
             if self.compile_cache is not None else {})
+        from ...resilience.stats import resilience_snapshot
         return {"study": self.name, "status": self._status,
+                "resilience": resilience_snapshot(),
                 "compile": compile_snap,
                 "ckpt": (self._ckpt_plane.stats.snapshot()
                          if self._ckpt_plane is not None else {}),

@@ -39,6 +39,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..resilience.retry import RetryPolicy
 from . import format as fmt
 from .format import parse_step  # noqa: F401 — re-exported (ckpt.parse_step)
 from .stats import CkptStats
@@ -73,7 +74,8 @@ class CheckpointPlane:
                  async_save: bool = True, max_inflight: int = 2,
                  fsync: bool = True, gc_min_interval_s: float = 30.0,
                  gc_grace_s: float = 120.0,
-                 stats: Optional[CkptStats] = None):
+                 stats: Optional[CkptStats] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.root = root
         self.keep_last_k = keep_last_k
         self.keep_best_k = keep_best_k
@@ -100,6 +102,20 @@ class CheckpointPlane:
         self._last_gc = float("-inf")
         self._gc_deferred = False
         self._flush_error: Optional[BaseException] = None
+        # blob IO rides the shared resilience RetryPolicy: a transient
+        # write failure (EINTR/EIO blip, NFS hiccup, injected chaos fault)
+        # is retried with bounded backoff on the writer thread instead of
+        # dropping the whole checkpoint on the floor; genuinely fatal
+        # errors (ENOSPC surfaces as OSError too, but persists through the
+        # budget) still land in _flush_error for flush() to report
+        # the knob counts RETRIES (what its name says); max_attempts is
+        # total tries, so +1 — ZOO_CKPT_IO_RETRIES=1 means one retry, not
+        # silently none
+        self._io_retry = retry_policy if retry_policy is not None else \
+            RetryPolicy(max_attempts=1 + max(0, int(os.environ.get(
+                            "ZOO_CKPT_IO_RETRIES", "2"))),
+                        base_delay_s=0.1, max_delay_s=2.0, jitter_frac=0.0,
+                        name="ckpt.blob_io")
 
     # --- save ---------------------------------------------------------------
     def _ckpt_dir(self, step: int, name: Optional[str]) -> str:
@@ -175,8 +191,9 @@ class CheckpointPlane:
             for arr in job.leaves:
                 raw = arr.tobytes()
                 digest = fmt.digest_of(raw)
-                wrote = self.store.put(digest, raw, self.encrypted,
-                                       self.passphrase, fsync=self.fsync)
+                wrote = self._io_retry.call(
+                    self.store.put, digest, raw, self.encrypted,
+                    self.passphrase, fsync=self.fsync)
                 self.stats.add(bytes_logical=len(raw),
                                **({"bytes_written": len(raw),
                                    "blobs_written": 1} if wrote else
@@ -184,8 +201,9 @@ class CheckpointPlane:
                                    "blobs_deduped": 1}))
                 leaf_recs.append(fmt.leaf_record(arr, digest))
             sk_digest = fmt.digest_of(job.skeleton)
-            wrote = self.store.put(sk_digest, job.skeleton, self.encrypted,
-                                   self.passphrase, fsync=self.fsync)
+            wrote = self._io_retry.call(
+                self.store.put, sk_digest, job.skeleton, self.encrypted,
+                self.passphrase, fsync=self.fsync)
             self.stats.add(bytes_logical=len(job.skeleton),
                            **({"bytes_written": len(job.skeleton),
                                "blobs_written": 1} if wrote else

@@ -25,6 +25,7 @@ import time
 import uuid
 from typing import Optional, Set, Tuple
 
+from ..resilience import faults as _faults
 from .format import MANIFEST_NAME
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -47,6 +48,7 @@ class BlobStore:
             passphrase: Optional[str] = None, fsync: bool = True) -> bool:
         """Store ``data`` (plaintext) under its plaintext digest. Returns
         True when bytes were actually written, False on a dedup hit."""
+        _faults.fire("ckpt.blob_io")     # chaos hook: model a failing disk
         final = self.path(digest, encrypted)
         if os.path.exists(final):
             # bump mtime: the blob is "in use" again, which keeps another

@@ -36,6 +36,9 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
+
 __all__ = ["narrow_wire", "wire_nbytes", "sharded_put", "put_tree",
            "StagingPool", "staging_enabled", "default_h2d_lanes",
            "MAX_H2D_LANES"]
@@ -134,6 +137,24 @@ def sharded_put(arr, sharding, stats=None):
 
 
 def _place(jax, a, sharding):
+    # resilience hooks: the `h2d.put` fault site (chaos tests model a lost
+    # DMA link here) and the dispatch watchdog's H2D wait bound — both one
+    # global read when disarmed. The fault fires INSIDE the watched
+    # section so a delay-mode fault (modelling a hung DMA) trips the
+    # watchdog like the real thing would.
+    wd = _watchdog.active()
+    if wd is not None:
+        token = wd.enter("h2d.put")
+        try:
+            _faults.fire("h2d.put")
+            return _place_inner(jax, a, sharding)
+        finally:
+            wd.exit(token)
+    _faults.fire("h2d.put")
+    return _place_inner(jax, a, sharding)
+
+
+def _place_inner(jax, a, sharding):
     if jax.process_count() > 1:
         return jax.make_array_from_process_local_data(sharding, a)
     try:

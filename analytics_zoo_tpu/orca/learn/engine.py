@@ -29,6 +29,8 @@ import optax
 from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...resilience import faults as _faults
+from ...resilience import watchdog as _watchdog
 from .metrics import Metric
 from .utils import Batch
 
@@ -448,10 +450,21 @@ class TrainEngine:
 
     def train_batch(self, batch: Batch) -> jnp.ndarray:
         self.ensure_jit_train()
+        # resilience hooks (one global read each when disarmed): the
+        # `engine.dispatch` fault site, and a watchdog section bounding the
+        # dispatch so a wedged device becomes a classified hang
+        wd = _watchdog.active()
+        token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
-        self.params, self.extra_vars, self.opt_state, loss = self._jit_train(
-            self.params, self.extra_vars, self.opt_state,
-            jnp.asarray(self.step), batch.x, batch.y, batch.w)
+        try:
+            _faults.fire("engine.dispatch")
+            self.params, self.extra_vars, self.opt_state, loss = \
+                self._jit_train(
+                    self.params, self.extra_vars, self.opt_state,
+                    jnp.asarray(self.step), batch.x, batch.y, batch.w)
+        finally:
+            if token is not None:
+                wd.exit(token)
         if self.pipeline_stats is not None:
             self.pipeline_stats.add("step", time.perf_counter() - t0)
         self.step += 1
@@ -465,11 +478,18 @@ class TrainEngine:
             self._jit_train_multi = self._wrap("train_multi",
                                                self._train_multi_step,
                                                donate_argnums=(0, 2))
+        wd = _watchdog.active()
+        token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
-        self.params, self.extra_vars, self.opt_state, losses = \
-            self._jit_train_multi(
-                self.params, self.extra_vars, self.opt_state,
-                jnp.asarray(self.step), batch.x, batch.y, batch.w)
+        try:
+            _faults.fire("engine.dispatch")
+            self.params, self.extra_vars, self.opt_state, losses = \
+                self._jit_train_multi(
+                    self.params, self.extra_vars, self.opt_state,
+                    jnp.asarray(self.step), batch.x, batch.y, batch.w)
+        finally:
+            if token is not None:
+                wd.exit(token)
         k = int(losses.shape[0])
         if self.pipeline_stats is not None:
             self.pipeline_stats.add("step", time.perf_counter() - t0,

@@ -163,6 +163,13 @@ class TPUEstimator:
             # (estimated) compile seconds saved, cumulative for the cache
             # this engine compiles through (shared process-wide by default)
             snap["compile"] = self.engine.compile_cache.stats.snapshot()
+        from ...resilience.stats import resilience_snapshot
+        res = resilience_snapshot()
+        if res:
+            # resilience-plane counters (process-wide: faults fired,
+            # watchdog trips, supervisor restarts, retries) — omitted on
+            # healthy runs so existing consumers see no new key
+            snap["resilience"] = res
         if reset:
             self._pipeline_stats.reset()
         return snap
@@ -613,7 +620,10 @@ class TPUEstimator:
         finally:
             if tracing:
                 jax.profiler.stop_trace()
-        host_losses = jax.device_get(losses)
+        # the epoch-end sync is where a wedged device actually blocks on
+        # real TPUs (dispatch is async) — bound it like the dispatches
+        from ...resilience.watchdog import watched
+        host_losses = watched("engine.sync", jax.device_get, losses)
         if host_losses:
             host_losses = np.concatenate(
                 [np.atleast_1d(np.asarray(l)) for l in host_losses])
@@ -665,7 +675,9 @@ class TPUEstimator:
                 states, batch_loss, n = self.engine.eval_batch(states, batch)
             losses.append(batch_loss)
             counts.append(n)
-        host_losses, host_counts = jax.device_get((losses, counts))
+        from ...resilience.watchdog import watched
+        host_losses, host_counts = watched("engine.sync", jax.device_get,
+                                           (losses, counts))
         loss_sum = float(np.sum(host_losses))
         count = float(np.sum(host_counts))
         result = self.engine.finalize_metrics(states, loss_sum, count)
@@ -824,28 +836,31 @@ class TPUEstimator:
         self.engine.set_state(state)
         return self
 
-    def save_checkpoint(self, model_dir: str, blocking: bool = False):
+    def save_checkpoint(self, model_dir: str, blocking: bool = False,
+                        meta: Optional[Dict] = None):
         """Checkpoint through the plane (analytics_zoo_tpu.ckpt): per-leaf
         content-addressed blobs + manifest, committed atomically. By
         default the write drains on the plane's writer thread — the loop
         pays only the device→host snapshot; ``blocking=True`` (or config
-        ``ckpt_async: False``) waits for the committed write."""
+        ``ckpt_async: False``) waits for the committed write. ``meta``
+        rides the manifest (the training supervisor records its epoch
+        boundary there)."""
         plane = self._ckpt(model_dir)
         path = plane.save(self.engine.get_state(), self.engine.step,
                           score=self._trainer_state.score,
-                          blocking=blocking)
+                          meta=meta, blocking=blocking)
         logger.info("checkpoint %s: %s",
                     "saved" if blocking else "queued", path)
         return path
 
-    def load_checkpoint(self, model_dir: str):
-        """Restore the newest *committed* checkpoint: pending async writes
-        are flushed first, uncommitted/corrupt dirs are skipped with
-        fallback to the previous good one, and legacy ``state.pkl``
-        checkpoints load unchanged."""
+    def load_checkpoint(self, model_dir: str, step: Optional[int] = None):
+        """Restore the newest *committed* checkpoint (or exactly ``step``):
+        pending async writes are flushed first, uncommitted/corrupt dirs
+        are skipped with fallback to the previous good one, and legacy
+        ``state.pkl`` checkpoints load unchanged."""
         plane = self._ckpt(model_dir)
         try:
-            path, state = plane.restore()
+            path, state = plane.restore(step=step)
         except FileNotFoundError:
             raise FileNotFoundError(f"no checkpoint under {model_dir}")
         if self.engine.params is None:

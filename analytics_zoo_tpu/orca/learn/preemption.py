@@ -35,8 +35,14 @@ class PreemptionWatcher:
     a repeated SIGTERM falls through to the prior handler so an operator
     can still force-stop."""
 
-    def __init__(self, signals=(signal.SIGTERM,)):
+    def __init__(self, signals=(signal.SIGTERM,), on_signal=None):
+        """``on_signal(signum)``: invoked from the handler on the FIRST
+        signal, after the flag latches — the one SIGTERM entry point the
+        training supervisor (checkpoint-and-stop) and the serving drain
+        path (stop accepting, finish in-flight) share. Runs in signal
+        context: keep it non-blocking (set an event, start a thread)."""
         self._signals = tuple(signals)
+        self._on_signal = on_signal
         self._prev = {}
         self._event = threading.Event()
         self._installed = False
@@ -59,6 +65,12 @@ class PreemptionWatcher:
             "received signal %d (preemption notice): finishing the current "
             "step, checkpointing, and stopping", signum)
         self._event.set()
+        if self._on_signal is not None:
+            try:
+                self._on_signal(signum)
+            except Exception:   # noqa: BLE001 — a callback bug must not
+                logger.exception(   # turn a clean preemption into a crash
+                    "preemption on_signal callback failed")
 
     def __enter__(self) -> "PreemptionWatcher":
         if threading.current_thread() is not threading.main_thread():

@@ -1,0 +1,50 @@
+"""Process-wide resilience counters.
+
+One flat, thread-safe counter table shared by every resilience component:
+the fault registry reports fires per site, the watchdog reports trips, the
+supervisor reports restarts / replayed steps, RetryPolicy reports retries,
+and the serving engine reports shed requests and breaker transitions. The
+existing observability surfaces pick the snapshot up —
+``estimator.data_pipeline_stats()["resilience"]``, serving
+``metrics()["resilience"]`` / HTTP ``/metrics``, and
+``TrialRuntime.summary()["resilience"]`` — so a pod operator reads fault
+history in the same place as throughput.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["ResilienceStats", "STATS", "resilience_snapshot"]
+
+
+class ResilienceStats:
+    """Monotonic named counters; empty snapshot until something happens, so
+    surfaces can omit the section on healthy runs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, float] = {}
+
+    def add(self, key: str, n: float = 1):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in sorted(self._counts.items())}
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+
+
+#: the process-wide table every resilience component reports into
+STATS = ResilienceStats()
+
+
+def resilience_snapshot() -> Dict[str, float]:
+    """Global resilience counters (empty dict when nothing has fired)."""
+    return STATS.snapshot()

@@ -22,6 +22,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..pipeline.inference.inference_model import InferenceModel
+from ..resilience import faults as _faults
+from ..resilience.retry import CircuitBreaker
+from ..resilience.stats import STATS
 from .codecs import decode_payload, densify, encode_payload
 from .queue_api import Broker, make_broker
 
@@ -69,7 +72,9 @@ class ClusterServing:
     def __init__(self, model: InferenceModel,
                  queue: str = "memory://serving_stream",
                  batch_size: int = 32, batch_timeout_ms: float = 5.0,
-                 model_parallelism: int = 1):
+                 model_parallelism: int = 1,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 30.0):
         self.model = model
         self.broker: Broker = make_broker(queue) if isinstance(queue, str) \
             else queue
@@ -81,8 +86,28 @@ class ClusterServing:
         self.num_workers = model_parallelism
         self.timer = Timer()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._threads: List[threading.Thread] = []
         self.records_out = 0
+        # overload safety: expired requests are shed BEFORE device
+        # dispatch; the breaker opens after `breaker_threshold` consecutive
+        # batch failures so a wedged model/device sheds fast instead of
+        # burning every request's deadline against it, half-opening on one
+        # probe after the cooldown
+        self.breaker = CircuitBreaker(threshold=breaker_threshold,
+                                      cooldown_s=breaker_cooldown_s,
+                                      name="serving")
+        self._res_lock = threading.Lock()
+        self._res = {"shed_expired": 0, "shed_open": 0, "batch_failures": 0,
+                     "decode_errors": 0}
+
+    def _count(self, key: str, n: int = 1):
+        with self._res_lock:
+            self._res[key] += n
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     # --- worker loop --------------------------------------------------------
     def _worker(self):
@@ -91,21 +116,94 @@ class ClusterServing:
                 batch = self.broker.claim_batch(self.batch_size,
                                                 self.batch_timeout)
             if not batch:
+                if self._draining.is_set():
+                    return      # drained: queue empty, stop claiming
                 continue
-            try:
-                self._process(batch)
-            except Exception as e:  # noqa: BLE001 — serving must not die
-                logger.exception("serving batch failed: %s", e)
-                for item_id, _ in batch:
-                    self.broker.put_result(item_id, encode_payload(
-                        np.zeros(0), meta={"error": str(e)}))
+            self._handle(batch)
 
-    def _process(self, batch):
+    def _handle(self, batch):
+        """Decode + shed + breaker-gate + process one claimed batch. Every
+        claimed item gets a result — error payloads for shed/failed ones —
+        so frontend fetches never wait out their full timeout on a request
+        the engine already gave up on."""
+        try:
+            live = self._decode_and_shed(batch)
+        except Exception as e:  # noqa: BLE001 — injected/decode-stage fault
+            self.breaker.record_failure()
+            self._count("batch_failures")
+            logger.exception("serving decode stage failed: %s", e)
+            for item_id, _ in batch:
+                self.broker.put_result(item_id, encode_payload(
+                    np.zeros(0), meta={"error": str(e)}))
+            return
+        if not live:
+            return
+        if not self.breaker.allow():
+            # open circuit: fail fast, the device never sees the batch
+            self._count("shed_open", len(live))
+            STATS.add("serving.shed_open", len(live))
+            for item_id, _arr, _meta in live:
+                self.broker.put_result(item_id, encode_payload(
+                    np.zeros(0), meta={"error": "circuit open",
+                                       "shed": "circuit_open"}))
+            return
+        try:
+            self._process(live)
+            self.breaker.record_success()
+        except Exception as e:  # noqa: BLE001 — serving must not die
+            self.breaker.record_failure()
+            self._count("batch_failures")
+            logger.exception("serving batch failed: %s", e)
+            for item_id, _arr, _meta in live:
+                self.broker.put_result(item_id, encode_payload(
+                    np.zeros(0), meta={"error": str(e)}))
+
+    def _decode_and_shed(self, batch):
+        """Per-item decode (one malformed record fails itself, not its
+        batchmates) + deadline shedding: a request whose ``meta.deadline``
+        (absolute epoch seconds, stamped at admission) has passed is
+        answered with an error payload and NEVER reaches the device."""
+        live = []
         with self.timer.time("decode"):
-            decoded = [decode_payload(p) for _, p in batch]
-            # sparse ingress (reference: http/domains.scala:100) densifies
-            # at batch assembly — the TPU executable wants static dense
-            arrays = [densify(d) for d, _ in decoded]
+            _faults.fire("serving.decode")  # chaos hook (whole batch)
+            now = time.time()
+            for item_id, payload in batch:
+                try:
+                    data, meta = decode_payload(payload)
+                    # deadline parse is per-item too: a client that sends
+                    # meta={"deadline": "soon"} must fail itself, not
+                    # feed the breaker and fail its batchmates
+                    deadline = meta.get("deadline")
+                    expired = (deadline is not None
+                               and now > float(deadline))
+                except Exception as e:      # noqa: BLE001 — bad record
+                    self._count("decode_errors")
+                    self.broker.put_result(item_id, encode_payload(
+                        np.zeros(0), meta={"error": f"bad payload: {e}"}))
+                    continue
+                if expired:
+                    self._count("shed_expired")
+                    STATS.add("serving.shed_expired")
+                    self.broker.put_result(item_id, encode_payload(
+                        np.zeros(0),
+                        meta={"error": "deadline exceeded",
+                              "shed": "expired"}))
+                    continue
+                # sparse ingress (reference: http/domains.scala:100)
+                # densifies at batch assembly — the TPU executable wants
+                # static dense. Per-item like the decode: a record that
+                # decodes but won't densify (out-of-range sparse indices)
+                # fails itself, not its batchmates
+                try:
+                    live.append((item_id, densify(data), meta))
+                except Exception as e:      # noqa: BLE001 — bad record
+                    self._count("decode_errors")
+                    self.broker.put_result(item_id, encode_payload(
+                        np.zeros(0), meta={"error": f"bad payload: {e}"}))
+        return live
+
+    def _process(self, live):
+        arrays = [a for _, a, _ in live]
         with self.timer.time("batch"):
             first = arrays[0]
             if isinstance(first, list):
@@ -136,13 +234,13 @@ class ClusterServing:
             preds = self.model.predict(stacked)
         with self.timer.time("encode"):
             multi = isinstance(preds, (list, tuple))
-            for i, (item_id, _) in enumerate(batch):
+            for i, (item_id, _arr, _meta) in enumerate(live):
                 if multi:
                     out = [np.asarray(p[i]) for p in preds]
                 else:
                     out = np.asarray(preds[i])
                 self.broker.put_result(item_id, encode_payload(out))
-        self.records_out += len(batch)
+        self.records_out += len(live)
 
     # --- lifecycle ----------------------------------------------------------
     def start(self, example=None):
@@ -169,15 +267,50 @@ class ClusterServing:
         for t in self._threads:
             t.join(timeout=5)
 
+    def drain(self, timeout_s: float = 30.0) -> Dict:
+        """Graceful shutdown (the SIGTERM path, shared with the training
+        supervisor via ``PreemptionWatcher(on_signal=...)``): stop
+        *accepting* (the frontend 503s while ``draining``), let the workers
+        finish every already-admitted request — in-flight batches AND the
+        queued backlog — then stop and return the final metrics snapshot
+        (flushed to the log, the Flink analogue of a savepoint-stop)."""
+        self._draining.set()
+        STATS.add("serving.drains")
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1)
+        snap = self.metrics()
+        logger.info("serving drained (records_out=%d, pending=%s): %s",
+                    self.records_out,
+                    self._safe_pending(), snap.get("resilience"))
+        return snap
+
+    def _safe_pending(self):
+        try:
+            return self.broker.pending()
+        except Exception:       # noqa: BLE001 — broker may already be down
+            return None
+
     def metrics(self) -> Dict:
         """(reference observability: Flink numRecordsOutPerSecond +
         Timer stats)"""
+        with self._res_lock:
+            res = dict(self._res)
+        res["breaker"] = self.breaker.snapshot()
+        res["draining"] = self.draining
         out = {"records_out": self.records_out,
                # batch-dim sharding spreads every batch over these chips
                # (reference scales with model replicas / Flink parallelism);
                # 1 for eager/call_tf models, which compute host-side
                "devices": getattr(self.model, "device_count", 1),
-               "stages": self.timer.summary()}
+               "stages": self.timer.summary(),
+               # overload/fault counters: expired requests shed before
+               # dispatch, open-circuit sheds, breaker state — the serving
+               # face of the resilience plane
+               "resilience": res}
         if hasattr(self.model, "transfer_stats"):
             # transfer-plane counters: serving-ingress h2d seconds/bytes/
             # MB/s from the sharded device_put path (native/transfer.py)

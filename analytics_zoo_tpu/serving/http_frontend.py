@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import hmac
+import time
 import uuid
 from typing import Optional
 
@@ -39,19 +40,32 @@ def _parse_tensor_value(v):
 
 
 def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
-               serving=None, auth_token: Optional[str] = None):
+               serving=None, auth_token: Optional[str] = None,
+               max_pending: Optional[int] = None):
     """``serving``: optional ClusterServing engine to expose under
     GET /metrics (the reference surfaces Flink numRecordsOutPerSecond +
     stage timers the same way, ClusterServingGuide:525). ``auth_token``:
     when set, every route but GET / requires
-    ``Authorization: Bearer <auth_token>``."""
+    ``Authorization: Bearer <auth_token>``.
+
+    Overload safety (resilience plane): ``max_pending`` bounds the broker
+    backlog — a predict that would push it past the bound is rejected with
+    429 + ``Retry-After`` *before* anything is enqueued. Every admitted
+    instance carries an absolute deadline (``timeout_s``, or the request's
+    ``X-Timeout-S`` header if tighter) in its payload meta; the engine
+    sheds expired requests before device dispatch. ``GET /healthz`` is
+    process liveness, ``GET /readyz`` flips 503 while draining or while
+    the serving circuit breaker is open."""
     from aiohttp import web
 
     broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
+    counters = {"rejected_429": 0, "expired_results": 0}
 
     @web.middleware
     async def auth_middleware(request, handler):
-        if auth_token and request.path != "/":
+        # liveness/readiness probes run tokenless (orchestrator probes
+        # cannot carry secrets), like GET /
+        if auth_token and request.path not in ("/", "/healthz", "/readyz"):
             header = request.headers.get("Authorization", "")
             # compare as bytes: str compare_digest raises on non-ASCII
             # header values, which must 401, not 500
@@ -67,12 +81,29 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         return web.Response(text="welcome to analytics zoo tpu serving "
                                  "frontend")
 
+    async def healthz(request):
+        # liveness: the process answers — orchestrators restart on failure
+        return web.json_response({"status": "ok"})
+
+    async def readyz(request):
+        # readiness: stop routing traffic here while draining (SIGTERM
+        # grace window) or while the breaker has the model circuit open
+        if serving is not None:
+            if serving.draining:
+                return web.json_response(
+                    {"status": "draining"}, status=503)
+            if serving.breaker.snapshot()["state"] == "open":
+                return web.json_response(
+                    {"status": "circuit_open"}, status=503)
+        return web.json_response({"status": "ready"})
+
     async def metrics(request):
         # pending() can block (Redis XLEN round-trip, spool-dir listing) —
         # keep it off the event loop like the predict handler's fetches
         loop = asyncio.get_running_loop()
         pending = await loop.run_in_executor(None, broker.pending)
         from ..compile import compile_stats
+        from ..resilience.stats import resilience_snapshot
         # compile-plane counters are surfaced even without an embedded
         # worker (an external worker in this process shares the cache);
         # serving.metrics() refines them with the served model's own view
@@ -80,15 +111,40 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         body = {"pending": pending, "compile": compile_stats()}
         if serving is not None:
             body.update(serving.metrics())
+        # admission-layer overload counters (429 rejections, expired
+        # results observed at fetch) merge into the engine's resilience
+        # section; process-wide fault/retry/watchdog counters ride along
+        res = dict(body.get("resilience") or {})
+        res.update(counters)
+        glob = resilience_snapshot()
+        if glob:
+            res["process"] = glob
+        body["resilience"] = res
         return web.json_response(body)
 
     async def predict(request):
+        if serving is not None and serving.draining:
+            # stop accepting during the SIGTERM grace window; admitted
+            # requests are still drained to completion
+            return web.json_response({"error": "draining"}, status=503,
+                                     headers={"Retry-After": "5"})
         body = await request.json()
         instances = body.get("instances")
         if not isinstance(instances, list):
             return web.json_response({"error": "missing 'instances' list"},
                                      status=400)
         loop = asyncio.get_running_loop()
+        if max_pending is not None:
+            # bounded admission: reject BEFORE enqueuing anything, so an
+            # overloaded broker never grows past the bound from this door.
+            # Retry-After is a coarse hint: one batch-drain interval.
+            backlog = await loop.run_in_executor(None, broker.pending)
+            if backlog + len(instances) > max_pending:
+                counters["rejected_429"] += 1
+                return web.json_response(
+                    {"error": "queue full", "pending": backlog,
+                     "max_pending": max_pending},
+                    status=429, headers={"Retry-After": "1"})
         # parse + validate EVERY instance before enqueuing any: a malformed
         # instance mid-list must 400 without having orphaned earlier
         # instances' work/results on the broker
@@ -107,26 +163,45 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
                 # client error, not a 500
                 return web.json_response(
                     {"error": f"bad instance: {e}"}, status=400)
+        # deadline propagation: the engine sheds any request still queued
+        # past this instant instead of wasting device time on an answer
+        # nobody is waiting for. X-Timeout-S may only tighten the app-level
+        # timeout — a client cannot hold a slot longer than the server
+        # allows.
+        eff_timeout = timeout_s
+        hdr = request.headers.get("X-Timeout-S")
+        if hdr:
+            try:
+                eff_timeout = min(timeout_s, max(float(hdr), 0.0))
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad X-Timeout-S: {hdr!r}"}, status=400)
+        deadline = time.time() + eff_timeout
         uris = []
         for data in parsed:
             uri = uuid.uuid4().hex
-            broker.enqueue(uri, encode_payload(data, meta={"uri": uri}))
+            broker.enqueue(uri, encode_payload(
+                data, meta={"uri": uri, "deadline": deadline}))
             uris.append(uri)
 
         def fetch(uri):
-            raw = broker.get_result(uri, timeout_s)
+            raw = broker.get_result(uri, eff_timeout)
             if raw is None:
-                return None
+                return None, False
             arr, meta = decode_payload(raw)
             if meta.get("error"):
-                return {"error": meta["error"]}
+                return ({"error": meta["error"]},
+                        meta.get("shed") == "expired")
             if isinstance(arr, (list, tuple)):
-                return [a.tolist() for a in arr]
-            return arr.tolist()
+                return [a.tolist() for a in arr], False
+            return arr.tolist(), False
 
-        results = await asyncio.gather(
+        fetched = await asyncio.gather(
             *[loop.run_in_executor(None, fetch, u) for u in uris])
-        return web.json_response({"predictions": results})
+        # counters mutate on the event loop only — executor threads racing
+        # a bare dict increment would drop counts
+        counters["expired_results"] += sum(exp for _, exp in fetched)
+        return web.json_response({"predictions": [r for r, _ in fetched]})
 
     async def model_secure(request):
         """Store the secret/salt an encrypted model artifact is sealed with
@@ -149,6 +224,8 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
     app = web.Application(middlewares=[auth_middleware])
     app["model_secure"] = {}        # mutable holder, registered pre-startup
     app.router.add_get("/", index)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/predict", predict)
     app.router.add_put("/predict", predict)
@@ -169,12 +246,56 @@ def run_frontend(queue="memory://serving_stream", host: str = "0.0.0.0",
                  port: int = 10020, serving=None,
                  auth_token: Optional[str] = None,
                  ssl_certfile: Optional[str] = None,
-                 ssl_keyfile: Optional[str] = None):
+                 ssl_keyfile: Optional[str] = None,
+                 max_pending: Optional[int] = None,
+                 timeout_s: float = 30.0,
+                 graceful_sigterm: bool = True):
+    """Serve the app. With ``graceful_sigterm`` (default), SIGTERM drains
+    the embedded serving engine before the server exits — the one signal
+    entry point shared with the training supervisor
+    (``PreemptionWatcher(on_signal=...)``). aiohttp's own signal handlers
+    are disabled in that mode: ``run_app`` would otherwise install a
+    SIGTERM handler *after* ours (silently replacing it) and exit without
+    draining."""
+    import threading
+
     from aiohttp import web
+
+    from ..orca.learn.preemption import PreemptionWatcher
+
     ssl_ctx = (make_ssl_context(ssl_certfile, ssl_keyfile)
                if ssl_certfile and ssl_keyfile else None)
-    web.run_app(create_app(queue, serving=serving, auth_token=auth_token),
-                host=host, port=port, ssl_context=ssl_ctx)
+    app = create_app(queue, timeout_s=timeout_s, serving=serving,
+                     auth_token=auth_token, max_pending=max_pending)
+    if not graceful_sigterm:
+        web.run_app(app, host=host, port=port, ssl_context=ssl_ctx)
+        return
+    loop = asyncio.new_event_loop()
+
+    def _graceful_exit():
+        # GracefulExit is a SystemExit subclass: raising it inside a loop
+        # callback breaks run_app's run_until_complete exactly like
+        # aiohttp's own signal handler does
+        raise web.GracefulExit()
+
+    def _on_sigterm(signum):
+        def work():
+            try:
+                if serving is not None:
+                    serving.drain()
+            finally:
+                try:
+                    loop.call_soon_threadsafe(_graceful_exit)
+                except RuntimeError:    # loop already closed
+                    pass
+        # drain off the signal context: finish the admitted backlog, then
+        # stop the server
+        threading.Thread(target=work, daemon=True,
+                         name="serving-drain").start()
+
+    with PreemptionWatcher(on_signal=_on_sigterm):
+        web.run_app(app, host=host, port=port, ssl_context=ssl_ctx,
+                    loop=loop, handle_signals=False)
 
 
 def main(argv=None):
@@ -205,6 +326,14 @@ def main(argv=None):
                         "frozen .pb")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    p.add_argument("--max-pending", type=int, default=None,
+                   help="bounded admission: reject predicts with 429 + "
+                        "Retry-After once the broker backlog would exceed "
+                        "this (default unbounded)")
+    p.add_argument("--timeout-s", type=float, default=30.0,
+                   help="per-request deadline: results are awaited this "
+                        "long, and the engine sheds any request still "
+                        "queued past it before device dispatch")
     p.add_argument("--auth-token", default=None,
                    help="require 'Authorization: Bearer <token>' on every "
                         "route but GET / (reference model-secure/secured "
@@ -240,13 +369,22 @@ def main(argv=None):
         serving = ClusterServing(
             model, queue=args.queue, batch_size=args.batch_size,
             batch_timeout_ms=args.batch_timeout_ms).start()
+
+    # run_frontend owns graceful SIGTERM handling: stop accepting (readyz
+    # flips 503, predict 503s), finish every admitted request, flush the
+    # final metrics snapshot, then exit. A second SIGTERM falls through to
+    # the prior handler (force stop) via the watcher's chaining.
     try:
         run_frontend(queue=args.queue, host=args.host, port=args.port,
                      serving=serving, auth_token=args.auth_token,
                      ssl_certfile=args.https_cert,
-                     ssl_keyfile=args.https_key)
+                     ssl_keyfile=args.https_key,
+                     max_pending=args.max_pending,
+                     timeout_s=args.timeout_s)
     finally:
         if serving is not None:
+            if serving.draining:
+                serving.drain()     # finish in-flight before exiting
             serving.stop()
 
 

@@ -176,10 +176,30 @@ class RedisBroker(Broker):
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  stream: str = "serving_stream", group: str = "serving",
                  consumer: Optional[str] = None,
-                 claim_idle_ms: int = 30000):
+                 claim_idle_ms: int = 30000,
+                 retry_policy=None):
+        from ..resilience.retry import RetryPolicy
         from .redis_protocol import RedisClient, RedisError
         self._RedisClient = RedisClient
         self._RedisError = RedisError
+        # broker-loss resilience: a dropped/refused connection is retried
+        # through the shared RetryPolicy (reconnect happens inside
+        # RedisClient on the next call) instead of surfacing a raw
+        # ConnectionError to the serving worker loop. Stream semantics stay
+        # at-least-once: a retried XADD may duplicate an entry whose reply
+        # was lost, a retried XREADGROUP's lost claims land in the PEL
+        # where XAUTOCLAIM recovers them, HSET results are idempotent.
+        # the knob counts RETRIES (what its name says); max_attempts is
+        # total tries, so +1 — RETRIES=1 means one reconnect, not none
+        self._retry = retry_policy if retry_policy is not None else \
+            RetryPolicy(
+                max_attempts=1 + max(0, int(os.environ.get(
+                    "ZOO_BROKER_RECONNECT_RETRIES", "4"))),
+                base_delay_s=float(os.environ.get(
+                    "ZOO_BROKER_RECONNECT_BACKOFF_S", "0.2")),
+                max_delay_s=5.0, jitter_frac=0.1,
+                transient=(ConnectionError, TimeoutError, OSError),
+                name="broker.connect")
         self.host, self.port = host, port
         self.stream = stream.encode()
         self.group = group.encode()
@@ -203,8 +223,9 @@ class RedisBroker(Broker):
         self._pending_acks: Dict[str, List[bytes]] = {}
         self._pending_lock = threading.Lock()
         try:
-            self._conn().execute("XGROUP", "CREATE", self.stream, self.group,
-                                 "0", "MKSTREAM")
+            self._retry.call(
+                self._conn().execute, "XGROUP", "CREATE", self.stream,
+                self.group, "0", "MKSTREAM")
         except RedisError as e:
             if "BUSYGROUP" not in str(e):
                 raise
@@ -219,10 +240,16 @@ class RedisBroker(Broker):
         return c
 
     def enqueue(self, item_id, payload):
-        self._conn().execute("XADD", self.stream, "*",
-                             "uri", item_id, "data", payload)
+        self._retry.call(self._conn().execute, "XADD", self.stream, "*",
+                         "uri", item_id, "data", payload)
 
     def claim_batch(self, max_items, timeout_s):
+        # reconnect-with-backoff around the whole claim: lost claims whose
+        # reply vanished sit in the PEL until XAUTOCLAIM steals them back,
+        # so a retry cannot drop work
+        return self._retry.call(self._claim_batch, max_items, timeout_s)
+
+    def _claim_batch(self, max_items, timeout_s):
         # BLOCK 0 means "block forever" on real Redis — clamp to >=1ms so a
         # zero/sub-ms timeout stays a poll, matching the other brokers
         block_ms = max(1, int(timeout_s * 1000))
@@ -263,6 +290,9 @@ class RedisBroker(Broker):
         return batch
 
     def put_result(self, item_id, payload):
+        return self._retry.call(self._put_result, item_id, payload)
+
+    def _put_result(self, item_id, payload):
         c = self._conn()
         c.execute("HSET", b"result:" + item_id.encode(), "value", payload)
         # ack + trim only now that the result is durably published; entries
@@ -280,12 +310,14 @@ class RedisBroker(Broker):
 
     def get_result(self, item_id, timeout_s=10.0):
         key = b"result:" + item_id.encode()
-        c = self._conn()
         deadline = time.time() + timeout_s
         while True:
-            val = c.execute("HGET", key, "value")
+            # HGET/DEL are idempotent — each poll rides the reconnect
+            # policy individually so the deadline math stays honest
+            val = self._retry.call(self._conn().execute, "HGET", key,
+                                   "value")
             if val is not None:
-                c.execute("DEL", key)
+                self._retry.call(self._conn().execute, "DEL", key)
                 return val
             if time.time() >= deadline:
                 return None
@@ -295,6 +327,9 @@ class RedisBroker(Broker):
         """Backlog = stream length minus claimed-but-unacked entries, so it
         means the same thing as the other brokers' pending() (entries now
         stay in the stream until their result publishes)."""
+        return self._retry.call(self._pending)
+
+    def _pending(self):
         c = self._conn()
         backlog = int(c.execute("XLEN", self.stream))
         try:

@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..resilience import faults as _faults
+
 _CRLF = b"\r\n"
 
 
@@ -110,6 +112,7 @@ class RedisClient:
         self._connect()
 
     def _connect(self):
+        _faults.fire("broker.connect")  # chaos hook: model a dead broker
         if self._sock is not None:
             try:
                 self._sock.close()

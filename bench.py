@@ -1235,6 +1235,130 @@ def bench_ckpt(smoke: bool) -> dict:
             "state_mb": round(state_mb, 2)}
 
 
+def bench_resilience(smoke: bool) -> dict:
+    """Resilience-plane chaos microbench: injected mid-fit H2D fault →
+    supervisor auto-recovery, plus serving deadline shedding.
+
+    Training half: a fault-free ``fit(epochs=E)`` provides the reference
+    weights, then a :class:`TrainingSupervisor` runs the same training with
+    a one-shot ``h2d.put`` fault injected mid-run. Reported: ``downtime_s``
+    (teardown + rebuild + restore wall time), ``steps_replayed`` (optimizer
+    steps between the restored checkpoint and the failure point — work the
+    fault cost), ``restarts``, and ``bit_identical`` — the recovered run's
+    final params must equal the fault-free run's bit for bit (the CI chaos
+    gate).
+
+    Serving half: a mix of expired and live requests through
+    ``ClusterServing`` — expired ones must be shed with an error result
+    *before* device dispatch (``expired_never_dispatched``: the model saw
+    exactly the live records).
+    """
+    import shutil
+    import tempfile
+
+    import flax.linen as nn
+    import jax
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+    from analytics_zoo_tpu.resilience import TrainingSupervisor, faults
+    from analytics_zoo_tpu.serving import ClusterServing, InMemoryBroker
+    from analytics_zoo_tpu.serving.codecs import (decode_payload,
+                                                  encode_payload)
+
+    class _Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    n = 128 if smoke else 512
+    data = {"x": rng.rand(n, 8).astype(np.float32),
+            "y": rng.rand(n).astype(np.float32)}
+    epochs, batch = (3, 32)
+
+    def make_est(model_dir=None):
+        return TPUEstimator(_Net(), loss="mse", optimizer="adam",
+                            model_dir=model_dir, seed=0,
+                            config={"steps_per_dispatch": 1})
+
+    root = tempfile.mkdtemp(prefix="zoo-resilience-bench-")
+    try:
+        # reference: uninterrupted, unsupervised
+        ref = make_est()
+        ref.fit(dict(data), epochs=epochs, batch_size=batch, verbose=False)
+        ref_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(ref.engine.get_state()["params"]))
+
+        sup = TrainingSupervisor(lambda: make_est(root), model_dir=root,
+                                 max_restarts=3)
+        # one-shot H2D fault mid-run: skip past epoch 1's transfers so the
+        # recovery really replays from a non-trivial checkpoint
+        steps = n // batch
+        with faults.inject("h2d.put", count=1, skip=3 * steps):
+            t0 = time.perf_counter()
+            report = sup.fit(dict(data), epochs=epochs, batch_size=batch)
+            wall_s = time.perf_counter() - t0
+        got_leaves = jax.tree_util.tree_leaves(jax.device_get(
+            sup.estimator.engine.get_state()["params"]))
+        bit_identical = len(ref_leaves) == len(got_leaves) and all(
+            np.array_equal(a, b) for a, b in zip(ref_leaves, got_leaves))
+        sup.estimator.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # serving overload: expired requests shed before device dispatch
+    class _CountingModel:
+        def __init__(self):
+            self.seen = 0
+
+        def predict(self, x):
+            self.seen += int(np.asarray(x).shape[0])
+            return np.asarray(x) * 2.0
+
+    model = _CountingModel()
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=8,
+                        batch_timeout_ms=5.0)
+    n_expired, n_live = 4, 4
+    for i in range(n_expired):
+        broker.enqueue(f"x{i}", encode_payload(
+            np.ones(3, np.float32), meta={"deadline": time.time() - 1.0}))
+    for i in range(n_live):
+        broker.enqueue(f"l{i}", encode_payload(
+            np.ones(3, np.float32), meta={"deadline": time.time() + 30.0}))
+    cs.start()
+    live_ok = expired_shed = 0
+    for i in range(n_live):
+        raw = broker.get_result(f"l{i}", timeout_s=10.0)
+        arr, meta = decode_payload(raw)
+        live_ok += int(not meta.get("error"))
+    for i in range(n_expired):
+        raw = broker.get_result(f"x{i}", timeout_s=10.0)
+        _, meta = decode_payload(raw)
+        expired_shed += int(meta.get("shed") == "expired")
+    serving_res = cs.metrics()["resilience"]
+    cs.stop()
+    expired_never_dispatched = model.seen == n_live
+
+    return {"metric": "resilience_recovery_downtime",
+            "value": round(report["downtime_s"], 4), "unit": "s",
+            "vs_baseline": 1.0,     # no reference analogue (Spark reran
+            "restarts": report["restarts"],         # whole stages instead)
+            "hangs": report["hangs"], "crashes": report["crashes"],
+            "steps_replayed": report["steps_replayed"],
+            "downtime_s": round(report["downtime_s"], 4),
+            "supervised_wall_s": round(wall_s, 3),
+            "bit_identical": bool(bit_identical),
+            "completed": bool(report["completed"]),
+            "shed_expired": serving_res["shed_expired"],
+            "live_served_ok": live_ok,
+            "expired_shed_results": expired_shed,
+            "expired_never_dispatched": bool(expired_never_dispatched),
+            "breaker_state": serving_res["breaker"]["state"],
+            "ok": bool(bit_identical and report["restarts"] >= 1
+                       and expired_never_dispatched)}
+
+
 def bench_real_host() -> int:
     """One-command e2e recipe for a REAL (direct-attached) TPU host.
 
@@ -1312,34 +1436,34 @@ def _init_context_cpu_fallback():
     later would have cleared — the driver grabs the chip lock while a
     previous holder is still tearing down. So: retry ``jax.devices()`` with
     exponential backoff up to BENCH_INIT_RETRIES attempts (default 3, base
-    delay BENCH_INIT_BACKOFF_S=2 doubling per attempt) and only then fall
-    back to JAX_PLATFORMS=cpu — a bench run on a genuinely chipless host
-    should measure the CPU path, not crash."""
+    delay BENCH_INIT_BACKOFF_S=2 doubling per attempt — driven by the
+    shared ``resilience.retry.RetryPolicy``) and only then fall back to
+    JAX_PLATFORMS=cpu — a bench run on a genuinely chipless host should
+    measure the CPU path, not crash."""
     import jax
     from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.resilience.retry import RetryPolicy
     attempts = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
     backoff = float(os.environ.get("BENCH_INIT_BACKOFF_S", "2"))
-    err = None
-    for attempt in range(1, attempts + 1):
+    policy = RetryPolicy(max_attempts=attempts, base_delay_s=backoff,
+                         max_delay_s=120.0, jitter_frac=0.0,
+                         transient=Exception,   # driver races look like
+                         name="bench.init")     # anything; retry them all
+
+    def _drop_cached_backend(attempt, exc, delay):
+        print(f"bench: accelerator init attempt {attempt}/{attempts} "
+              f"failed ({type(exc).__name__}: {exc}); retrying in "
+              f"{delay:.0f}s", file=sys.stderr)
         try:
-            jax.devices()
-            err = None
-            break
-        except Exception as e:          # noqa: BLE001 — driver init races
-            err = e
-            if attempt < attempts:
-                delay = backoff * (2 ** (attempt - 1))
-                print(f"bench: accelerator init attempt {attempt}/{attempts} "
-                      f"failed ({type(e).__name__}: {e}); retrying in "
-                      f"{delay:.0f}s", file=sys.stderr)
-                time.sleep(delay)
-                try:
-                    # jax caches failed backend init; drop it so the retry
-                    # actually re-probes the driver
-                    jax.clear_backends()
-                except Exception:       # noqa: BLE001 — best-effort
-                    pass
-    if err is not None:
+            # jax caches failed backend init; drop it so the retry
+            # actually re-probes the driver
+            jax.clear_backends()
+        except Exception:               # noqa: BLE001 — best-effort
+            pass
+
+    try:
+        policy.call(jax.devices, on_retry=_drop_cached_backend)
+    except Exception as err:            # noqa: BLE001 — budget exhausted
         print(f"bench: accelerator backend unavailable after {attempts} "
               f"attempts ({type(err).__name__}); falling back to "
               f"JAX_PLATFORMS=cpu", file=sys.stderr)
@@ -1411,7 +1535,8 @@ def main():
                "fraud_mlp": bench_fraud_mlp, "autots": bench_autots_trials,
                "serving_od": bench_serving_od, "attention": bench_attention,
                "compile_plane": bench_compile_plane,
-               "infeed": bench_infeed, "ckpt": bench_ckpt}
+               "infeed": bench_infeed, "ckpt": bench_ckpt,
+               "resilience": bench_resilience}
     # smoke runs must never clobber full-run artifacts (vs_baseline on a
     # reduced workload against a full-scale baseline is meaningless)
     detail_name = "BENCH_DETAIL_SMOKE.json" if smoke else "BENCH_DETAIL.json"

@@ -91,4 +91,68 @@ keys = ("saves", "stall_s", "hidden_s", "write_s", "stall_frac",
         "dedup_ratio", "bytes_written", "bytes_deduped")
 print("CKPT_PLANE=" + json.dumps({k: snap[k] for k in keys if k in snap}))
 EOF
+# resilience-plane snapshot: one injected mid-fit fault through the
+# training supervisor + a shed/breaker pass through the serving engine
+# (never affects the exit code)
+env JAX_PLATFORMS=cpu python - <<'EOF' 2>/dev/null || true
+import json
+import tempfile
+import time
+import numpy as np
+import flax.linen as nn
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+from analytics_zoo_tpu.resilience import TrainingSupervisor, faults
+from analytics_zoo_tpu.serving import ClusterServing, InMemoryBroker
+from analytics_zoo_tpu.serving.codecs import encode_payload
+
+init_orca_context("local")
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(x)[:, 0]
+
+rng = np.random.RandomState(0)
+data = {"x": rng.rand(64, 8).astype(np.float32),
+        "y": rng.rand(64).astype(np.float32)}
+with tempfile.TemporaryDirectory() as d:
+    sup = TrainingSupervisor(
+        lambda: TPUEstimator(M(), loss="mse", optimizer="adam",
+                             model_dir=d, seed=0,
+                             config={"steps_per_dispatch": 1}),
+        model_dir=d, max_restarts=2)
+    sup.retry_policy.base_delay_s = 0.05
+    with faults.inject("engine.dispatch", count=1, skip=3):
+        report = sup.fit(dict(data), epochs=2, batch_size=32)
+    sup.estimator.shutdown()
+
+class _Echo:
+    def predict(self, x):
+        return np.asarray(x)
+
+broker = InMemoryBroker()
+cs = ClusterServing(_Echo(), queue=broker, batch_size=4)
+for i in range(2):
+    broker.enqueue(f"x{i}", encode_payload(
+        np.ones(2, np.float32), meta={"deadline": time.time() - 1}))
+for i in range(2):
+    broker.enqueue(f"l{i}", encode_payload(
+        np.ones(2, np.float32), meta={"deadline": time.time() + 30}))
+cs.start()
+for i in range(2):
+    broker.get_result(f"l{i}", 10.0)
+    broker.get_result(f"x{i}", 10.0)
+res = cs.metrics()["resilience"]
+cs.drain(timeout_s=10.0)
+print("RESILIENCE=" + json.dumps({
+    "restarts": report["restarts"], "hangs": report["hangs"],
+    "crashes": report["crashes"],
+    "steps_replayed": report["steps_replayed"],
+    "downtime_s": round(report["downtime_s"], 3),
+    "bit_exact_resume": report["completed"],
+    "shed_expired": res["shed_expired"],
+    "shed_open": res["shed_open"],
+    "breaker_state": res["breaker"]["state"]}))
+EOF
 exit $rc

@@ -1,0 +1,762 @@
+"""Chaos suite for the resilience plane (analytics_zoo_tpu/resilience/).
+
+Covers: deterministic fault injection under a fixed seed, watchdog hang
+detection on a stalled dispatch, supervisor auto-recovery with bit-exact
+resume vs an uninterrupted run, deadline shedding (an expired request
+never reaches the model), bounded-admission 429, circuit-breaker
+trip/half-open, graceful drain completing in-flight requests, broker
+reconnect-with-backoff, checkpoint blob-IO retry, and nested
+PreemptionWatcher handler restoration.
+"""
+
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.resilience import (CircuitBreaker, DispatchTimeout,
+                                          DispatchWatchdog, RetryPolicy,
+                                          SupervisorGiveUp,
+                                          TrainingSupervisor, classify,
+                                          faults, resilience_snapshot)
+from analytics_zoo_tpu.serving import ClusterServing, InMemoryBroker
+from analytics_zoo_tpu.serving.codecs import decode_payload, encode_payload
+
+
+# --------------------------------------------------------------------------
+# fault injection
+# --------------------------------------------------------------------------
+
+def _fire_pattern(reg, site, n=60):
+    out = []
+    for _ in range(n):
+        try:
+            reg.fire(site)
+            out.append(0)
+        except faults.InjectedFault:
+            out.append(1)
+    return out
+
+
+def test_fault_determinism_fixed_seed():
+    """Same seed -> the exact same fire pattern, independent of other
+    sites' interleaved draws (per-site RNG streams)."""
+    a = faults.FaultRegistry(seed=123)
+    a.arm("engine.dispatch", prob=0.3)
+    b = faults.FaultRegistry(seed=123)
+    b.arm("engine.dispatch", prob=0.3)
+    b.arm("h2d.put", prob=0.7)          # extra site must not shift a's draw
+    pat_a = _fire_pattern(a, "engine.dispatch")
+    interleaved = []
+    for _ in range(60):
+        try:
+            b.fire("h2d.put")
+        except faults.InjectedFault:
+            pass
+        try:
+            b.fire("engine.dispatch")
+            interleaved.append(0)
+        except faults.InjectedFault:
+            interleaved.append(1)
+    assert pat_a == interleaved
+    assert 0 < sum(pat_a) < 60          # p=0.3 actually fires sometimes
+    c = faults.FaultRegistry(seed=124)
+    c.arm("engine.dispatch", prob=0.3)
+    assert _fire_pattern(c, "engine.dispatch") != pat_a
+
+
+def test_fault_count_skip_and_env_spec():
+    reg = faults.registry_from_env(
+        "engine.dispatch:count=1,skip=2;broker.connect:kind=connection")
+    fired = []
+    for i in range(6):
+        try:
+            reg.fire("engine.dispatch")
+        except faults.InjectedFault:
+            fired.append(i)
+    assert fired == [2]                 # skip 2 eligible calls, fire once
+    with pytest.raises(ConnectionError):
+        reg.fire("broker.connect")      # kind=connection is a ConnectionError
+    assert faults.registry_from_env("") is None
+
+
+def test_inject_scope_restores_previous():
+    outer = faults.FaultRegistry()
+    faults.activate(outer)
+    try:
+        with faults.inject("h2d.put", count=1):
+            assert faults.enabled()
+            with pytest.raises(faults.InjectedFault):
+                faults.fire("h2d.put")
+        assert faults._active is outer
+    finally:
+        faults.deactivate()
+    faults.fire("h2d.put")              # disabled hook is a no-op
+
+
+# --------------------------------------------------------------------------
+# retry policy
+# --------------------------------------------------------------------------
+
+def test_retry_policy_transient_retried_fatal_not():
+    sleeps = []
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.1, max_delay_s=0.5,
+                    jitter_frac=0.0, sleep=sleeps.append, name="t")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("drop")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.1, 0.2]         # exponential, deterministic
+
+    fatal_calls = []
+
+    def fatal():
+        fatal_calls.append(1)
+        raise ValueError("config error")
+
+    with pytest.raises(ValueError):
+        p.call(fatal)
+    assert len(fatal_calls) == 1        # never retried
+
+    def always():
+        raise TimeoutError("down")
+
+    with pytest.raises(TimeoutError):
+        p.call(always)                  # budget exhausted -> last error
+
+
+def test_retry_policy_backoff_bounded():
+    p = RetryPolicy(max_attempts=10, base_delay_s=1.0, max_delay_s=4.0,
+                    jitter_frac=0.0)
+    assert [p.delay_for(n) for n in (1, 2, 3, 4, 5)] == \
+        [1.0, 2.0, 4.0, 4.0, 4.0]
+    # accelerator-runtime markers classify transient by message
+    assert p.is_transient(RuntimeError("backend UNAVAILABLE: chip busy"))
+    assert not p.is_transient(RuntimeError("shape mismatch"))
+
+
+# --------------------------------------------------------------------------
+# watchdog
+# --------------------------------------------------------------------------
+
+def test_watchdog_hang_detection_on_stalled_dispatch():
+    wd = DispatchWatchdog(timeout_s=0.15, poll_s=0.02)
+    try:
+        with pytest.raises(DispatchTimeout) as ei:
+            wd.run(time.sleep, 2.0, label="fake.dispatch")
+        assert classify(ei.value) == "hang"
+        assert wd.tripped.is_set()
+        # crash keeps its class
+
+        def boom():
+            raise RuntimeError("step failed")
+
+        with pytest.raises(RuntimeError) as ei:
+            wd.run(boom, label="fake.dispatch")
+        assert classify(ei.value) == "crash"
+        # guarded section: the monitor thread trips it while it runs
+        wd.reset()
+        token = wd.enter("engine.dispatch")
+        time.sleep(0.3)
+        wd.exit(token)
+        assert wd.tripped.is_set()
+        assert wd.snapshot()["by_label"]["engine.dispatch"] >= 1
+        # fast sections never trip
+        wd.reset()
+        token = wd.enter("engine.dispatch")
+        wd.exit(token)
+        time.sleep(0.05)
+        assert not wd.tripped.is_set()
+    finally:
+        wd.close()
+
+
+def test_delay_fault_in_h2d_trips_watchdog(orca_context):
+    """A delay-mode h2d.put fault (modelling a hung DMA) stalls INSIDE
+    the watched section, so the monitor classifies it as a hang."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu.native.transfer import sharded_put
+    from analytics_zoo_tpu.resilience import watchdog as wd_mod
+
+    wd = DispatchWatchdog(timeout_s=0.1, poll_s=0.02)
+    wd_mod.set_active(wd)
+    try:
+        sharding = NamedSharding(orca_context.mesh, P())
+        with faults.inject("h2d.put", count=1, mode="delay", delay_s=0.4):
+            out = sharded_put(np.ones(4, np.float32), sharding)
+        jax.block_until_ready(out)
+        assert wd.tripped.is_set()
+        assert wd.snapshot()["by_label"].get("h2d.put", 0) >= 1
+    finally:
+        wd_mod.clear_active()
+        wd.close()
+
+
+def test_watchdog_disabled_is_noop():
+    wd = DispatchWatchdog(timeout_s=None)
+    assert wd.enter("x") is None
+    wd.exit(None)
+    assert wd.run(lambda: 7) == 7
+    wd.close()
+
+
+# --------------------------------------------------------------------------
+# supervisor
+# --------------------------------------------------------------------------
+
+def _mlp_estimator(model_dir=None):
+    import flax.linen as nn
+
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(nn.relu(nn.Dense(8)(x)))[:, 0]
+
+    return TPUEstimator(Net(), loss="mse", optimizer="adam",
+                        model_dir=model_dir, seed=0,
+                        config={"steps_per_dispatch": 1})
+
+
+def _train_data(n=96):
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(n, 4).astype(np.float32),
+            "y": rng.rand(n).astype(np.float32)}
+
+
+def _params_leaves(est):
+    import jax
+    return jax.tree_util.tree_leaves(
+        jax.device_get(est.engine.get_state()["params"]))
+
+
+def test_supervisor_resume_bit_identity(orca_context, tmp_path):
+    """One-shot injected dispatch fault mid-fit: the supervisor restores
+    the last committed epoch boundary and the final weights are
+    bit-identical to an uninterrupted, unsupervised run."""
+    data = _train_data()
+    ref = _mlp_estimator()
+    ref.fit(dict(data), epochs=3, batch_size=32, verbose=False)
+    ref_leaves = _params_leaves(ref)
+
+    sup = TrainingSupervisor(lambda: _mlp_estimator(str(tmp_path)),
+                             model_dir=str(tmp_path), max_restarts=3)
+    sup.retry_policy.base_delay_s = 0.02
+    with faults.inject("engine.dispatch", count=1, skip=5):
+        report = sup.fit(dict(data), epochs=3, batch_size=32)
+    assert report["restarts"] == 1 and report["crashes"] == 1
+    assert report["completed"] and not report["preempted"]
+    assert report["steps_replayed"] >= 1    # the fault cost real work
+    got = _params_leaves(sup.estimator)
+    assert len(got) == len(ref_leaves)
+    assert all(np.array_equal(a, b) for a, b in zip(ref_leaves, got))
+    sup.estimator.shutdown()
+
+
+def test_supervisor_hang_recovery_via_watchdog(orca_context, tmp_path):
+    """A delay-mode fault stalls one dispatch past ZOO_DISPATCH_TIMEOUT_S:
+    the watchdog trips, the segment is abandoned as a *hang*, and training
+    still completes bit-identically."""
+    data = _train_data(64)
+    ref = _mlp_estimator()
+    ref.fit(dict(data), epochs=2, batch_size=32, verbose=False)
+    ref_leaves = _params_leaves(ref)
+
+    # the timeout must clear a cold dispatch (lowering/compile can take
+    # hundreds of ms on a loaded CPU host) while the injected stall blows
+    # well past it — exactly how ZOO_DISPATCH_TIMEOUT_S should be sized in
+    # production (≫ worst-case compile, ≪ "give up on the job")
+    sup = TrainingSupervisor(lambda: _mlp_estimator(str(tmp_path)),
+                             model_dir=str(tmp_path), max_restarts=2,
+                             dispatch_timeout_s=1.5, poll_s=0.02)
+    sup.retry_policy.base_delay_s = 0.02
+    with faults.inject("engine.dispatch", count=1, skip=1,
+                       mode="delay", delay_s=5.0):
+        report = sup.fit(dict(data), epochs=2, batch_size=32)
+    assert report["hangs"] == 1 and report["completed"], report
+    got = _params_leaves(sup.estimator)
+    assert all(np.array_equal(a, b) for a, b in zip(ref_leaves, got))
+    sup.estimator.shutdown()
+
+
+def test_supervisor_give_up_report(orca_context, tmp_path):
+    """Exhausting the restart budget escalates to SupervisorGiveUp with a
+    structured failure report, not a bare traceback."""
+    sup = TrainingSupervisor(lambda: _mlp_estimator(str(tmp_path)),
+                             model_dir=str(tmp_path), max_restarts=1)
+    sup.retry_policy.base_delay_s = 0.01
+    with faults.inject("engine.dispatch", prob=1.0):
+        with pytest.raises(SupervisorGiveUp) as ei:
+            sup.fit(_train_data(64), epochs=1, batch_size=32)
+    rep = ei.value.report
+    assert rep["restarts"] == 2 and len(rep["failures"]) == 2
+    assert all(f["kind"] == "crash" for f in rep["failures"])
+    assert "last_checkpoint" in rep
+
+
+def test_resilience_stats_surface(orca_context, tmp_path):
+    """Fault/restart counters surface through data_pipeline_stats()."""
+    sup = TrainingSupervisor(lambda: _mlp_estimator(str(tmp_path)),
+                             model_dir=str(tmp_path), max_restarts=2)
+    sup.retry_policy.base_delay_s = 0.02
+    with faults.inject("engine.dispatch", count=1, skip=1):
+        sup.fit(_train_data(64), epochs=1, batch_size=32)
+    snap = sup.estimator.data_pipeline_stats()
+    res = snap.get("resilience", {})
+    assert res.get("fault.engine.dispatch", 0) >= 1
+    assert res.get("supervisor.restarts", 0) >= 1
+    assert resilience_snapshot() == res
+    sup.estimator.shutdown()
+
+
+# --------------------------------------------------------------------------
+# serving: deadlines, breaker, drain
+# --------------------------------------------------------------------------
+
+class _CountingModel:
+    def __init__(self, fail_times=0, delay_s=0.0):
+        self.seen = 0
+        self.fail_times = fail_times
+        self.delay_s = delay_s
+
+    def predict(self, x):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("model wedged")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.seen += int(np.asarray(x).shape[0])
+        return np.asarray(x) * 2.0
+
+
+def test_deadline_shedding_expired_never_reaches_model():
+    model = _CountingModel()
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=8,
+                        batch_timeout_ms=5.0)
+    for i in range(3):
+        broker.enqueue(f"x{i}", encode_payload(
+            np.ones(3, np.float32), meta={"deadline": time.time() - 1.0}))
+    for i in range(3):
+        broker.enqueue(f"l{i}", encode_payload(
+            np.ones(3, np.float32), meta={"deadline": time.time() + 30.0}))
+    cs.start()
+    try:
+        for i in range(3):
+            arr, meta = decode_payload(broker.get_result(f"l{i}", 10.0))
+            assert not meta.get("error")
+            np.testing.assert_array_equal(arr, np.full(3, 2.0, np.float32))
+        for i in range(3):
+            _, meta = decode_payload(broker.get_result(f"x{i}", 10.0))
+            assert meta["error"] == "deadline exceeded"
+            assert meta["shed"] == "expired"
+        res = cs.metrics()["resilience"]
+        assert res["shed_expired"] == 3
+        assert model.seen == 3          # expired records never dispatched
+    finally:
+        cs.stop()
+
+
+def test_bad_record_fails_itself_not_batchmates(monkeypatch):
+    """A record that decodes but fails densification (e.g. a hand-crafted
+    wire payload — encode_payload validates, the wire doesn't) gets its
+    own error result; batchmates — including an already-shed expired one —
+    keep theirs, and the breaker stays closed (client data is not a model
+    failure)."""
+    import analytics_zoo_tpu.serving.engine as eng_mod
+
+    orig_densify = eng_mod.densify
+
+    def flaky_densify(d):
+        if isinstance(d, np.ndarray) and d.shape == (9,):
+            raise ValueError("indices out of range")
+        return orig_densify(d)
+
+    monkeypatch.setattr(eng_mod, "densify", flaky_densify)
+    model = _CountingModel()
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=4,
+                        batch_timeout_ms=50.0, breaker_threshold=1)
+    broker.enqueue("expired", encode_payload(
+        np.ones(2, np.float32), meta={"deadline": time.time() - 1.0}))
+    broker.enqueue("bad", encode_payload(np.ones(9, np.float32)))
+    broker.enqueue("good", encode_payload(np.ones(2, np.float32)))
+    cs.start()
+    try:
+        _, meta = decode_payload(broker.get_result("expired", 10.0))
+        assert meta["shed"] == "expired"
+        _, meta = decode_payload(broker.get_result("bad", 10.0))
+        assert "bad payload" in meta["error"]
+        arr, meta = decode_payload(broker.get_result("good", 10.0))
+        assert not meta.get("error")
+        np.testing.assert_array_equal(arr, np.full(2, 2.0, np.float32))
+        assert cs.breaker.snapshot()["state"] == "closed"
+        assert cs.metrics()["resilience"]["decode_errors"] == 1
+    finally:
+        cs.stop()
+
+
+def test_bad_deadline_meta_fails_itself_not_batchmates():
+    """A record with an unparseable deadline is a bad record, not a model
+    failure: it errors itself, batchmates flow, breaker stays closed."""
+    model = _CountingModel()
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=4,
+                        batch_timeout_ms=50.0, breaker_threshold=1)
+    broker.enqueue("bad", encode_payload(
+        np.ones(2, np.float32), meta={"deadline": "soon"}))
+    broker.enqueue("good", encode_payload(np.ones(2, np.float32)))
+    cs.start()
+    try:
+        _, meta = decode_payload(broker.get_result("bad", 10.0))
+        assert "bad payload" in meta["error"]
+        arr, meta = decode_payload(broker.get_result("good", 10.0))
+        assert not meta.get("error")
+        assert cs.breaker.snapshot()["state"] == "closed"
+    finally:
+        cs.stop()
+
+
+def test_breaker_snapshot_reports_half_open_after_cooldown():
+    """Regression: an idle open breaker must read half_open (probe
+    eligible) once the cooldown elapses, without any allow() call —
+    otherwise /readyz 503s forever on a traffic-removed server and
+    traffic never returns to run the closing probe."""
+    clock = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown_s=10.0,
+                       clock=lambda: clock[0])
+    b.record_failure()
+    assert b.snapshot()["state"] == "open"
+    assert b.snapshot()["cooldown_remaining_s"] == 10.0
+    clock[0] = 10.5
+    snap = b.snapshot()
+    assert snap["state"] == "half_open"         # no allow() ran
+    assert snap["cooldown_remaining_s"] == 0.0
+    assert b.allow()                            # the real transition
+
+
+def test_circuit_breaker_trip_and_half_open():
+    model = _CountingModel(fail_times=2)
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=1,
+                        batch_timeout_ms=5.0, breaker_threshold=2,
+                        breaker_cooldown_s=0.3)
+    cs.start()
+    try:
+        # two failing batches trip the breaker
+        for i in range(2):
+            broker.enqueue(f"f{i}", encode_payload(np.ones(2, np.float32)))
+            _, meta = decode_payload(broker.get_result(f"f{i}", 10.0))
+            assert "model wedged" in meta["error"]
+        deadline = time.time() + 5.0
+        while cs.breaker.snapshot()["state"] != "open":
+            assert time.time() < deadline
+            time.sleep(0.01)
+        # while open: shed fast, the model is never consulted
+        broker.enqueue("shed", encode_payload(np.ones(2, np.float32)))
+        _, meta = decode_payload(broker.get_result("shed", 10.0))
+        assert meta["error"] == "circuit open"
+        assert cs.metrics()["resilience"]["shed_open"] >= 1
+        # after the cooldown the next request is the half-open probe; the
+        # model is healthy again -> breaker closes and serving resumes
+        time.sleep(0.35)
+        broker.enqueue("probe", encode_payload(np.ones(2, np.float32)))
+        arr, meta = decode_payload(broker.get_result("probe", 10.0))
+        assert not meta.get("error")
+        assert cs.breaker.snapshot()["state"] == "closed"
+        assert cs.breaker.snapshot()["trips"] == 1
+    finally:
+        cs.stop()
+
+
+def test_graceful_drain_completes_inflight():
+    model = _CountingModel(delay_s=0.05)
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=2,
+                        batch_timeout_ms=5.0)
+    n = 8
+    for i in range(n):
+        broker.enqueue(f"d{i}", encode_payload(np.ones(2, np.float32)))
+    cs.start()
+    snap = cs.drain(timeout_s=30.0)     # stop accepting, finish backlog
+    assert cs.draining
+    assert broker.pending() == 0
+    for i in range(n):
+        raw = broker.get_result(f"d{i}", 1.0)
+        assert raw is not None, f"request d{i} dropped during drain"
+        _, meta = decode_payload(raw)
+        assert not meta.get("error")
+    assert snap["records_out"] == n
+    assert snap["resilience"]["draining"] is True
+
+
+def test_frontend_429_deadline_and_health(orca_context):
+    """Bounded admission 429 + Retry-After, deadline meta stamped on
+    enqueue, /healthz always up, /readyz 503 while draining, and the
+    429/expired counters in /metrics."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from analytics_zoo_tpu.serving.http_frontend import create_app
+
+    model = _CountingModel()
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=4,
+                        batch_timeout_ms=5.0)
+    app = create_app(queue=broker, timeout_s=5.0, serving=cs, max_pending=2)
+
+    async def run():
+        out = {}
+        async with TestClient(TestServer(app)) as client:
+            out["healthz"] = (await client.get("/healthz")).status
+            out["readyz"] = (await client.get("/readyz")).status
+            # worker not started: 3 instances > max_pending=2 -> 429
+            resp = await client.post(
+                "/predict", json={"instances": [[1.0], [2.0], [3.0]]})
+            out["status_429"] = resp.status
+            out["retry_after"] = resp.headers.get("Retry-After")
+            # start the worker, a small request flows and carries a deadline
+            cs.start()
+            resp = await client.post(
+                "/predict", json={"instances": [[1.0, 2.0]]})
+            out["ok_status"] = resp.status
+            out["ok_body"] = await resp.json()
+            # bad X-Timeout-S is a client error
+            resp = await client.post(
+                "/predict", json={"instances": [[1.0]]},
+                headers={"X-Timeout-S": "nope"})
+            out["bad_timeout"] = resp.status
+            out["metrics"] = await (await client.get("/metrics")).json()
+            # drain flips readiness and predict admission
+            cs.drain(timeout_s=10.0)
+            out["readyz_draining"] = (await client.get("/readyz")).status
+            out["predict_draining"] = (await client.post(
+                "/predict", json={"instances": [[1.0]]})).status
+        return out
+
+    try:
+        out = asyncio.new_event_loop().run_until_complete(run())
+    finally:
+        cs.stop()
+    assert out["healthz"] == 200 and out["readyz"] == 200
+    assert out["status_429"] == 429 and out["retry_after"] == "1"
+    assert out["ok_status"] == 200
+    assert out["ok_body"]["predictions"] == [[2.0, 4.0]]
+    assert out["bad_timeout"] == 400
+    res = out["metrics"]["resilience"]
+    assert res["rejected_429"] == 1
+    assert "expired_results" in res and "breaker" in res
+    assert out["readyz_draining"] == 503
+    assert out["predict_draining"] == 503
+
+
+def test_frontend_expired_counter(orca_context):
+    """Half the traffic past its deadline: the engine sheds it, the
+    frontend counts the expired results, and the model only ever sees the
+    live half (acceptance: overload never queues expired work on the
+    device)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from analytics_zoo_tpu.serving.http_frontend import create_app
+
+    model = _CountingModel(delay_s=0.2)
+    broker = InMemoryBroker()
+    cs = ClusterServing(model, queue=broker, batch_size=1,
+                        batch_timeout_ms=5.0)
+    app = create_app(queue=broker, timeout_s=5.0, serving=cs)
+
+    async def run():
+        async with TestClient(TestServer(app)) as client:
+            # a tight-deadline burst: the first request occupies the
+            # worker ~0.2s while the rest expire in the queue (deadline
+            # 0.1s), then a fresh request must still be served
+            burst = client.post("/predict",
+                                json={"instances": [[float(i)]
+                                                    for i in range(4)]},
+                                headers={"X-Timeout-S": "0.1"})
+            cs.start()
+            body = await (await burst).json()
+            ok = await client.post("/predict",
+                                   json={"instances": [[7.0]]})
+            m = await (await client.get("/metrics")).json()
+            return body, await ok.json(), m
+
+    try:
+        body, ok_body, m = asyncio.new_event_loop().run_until_complete(run())
+    finally:
+        cs.stop()
+    preds = body["predictions"]
+    expired = [p for p in preds
+               if isinstance(p, dict) and p.get("error") == "deadline "
+               "exceeded" or p is None]
+    assert expired, preds               # at least part of the burst expired
+    assert ok_body["predictions"] == [[14.0]]
+    assert m["resilience"]["shed_expired"] >= 1
+
+
+# --------------------------------------------------------------------------
+# broker reconnect + ckpt blob-IO retry
+# --------------------------------------------------------------------------
+
+def test_redis_broker_reconnects_with_backoff():
+    """A dropped broker connection is re-established with backoff by the
+    shared RetryPolicy instead of surfacing to the worker loop."""
+    from analytics_zoo_tpu.serving import MiniRedisServer, RedisBroker
+
+    srv = MiniRedisServer().start()
+    try:
+        broker = RedisBroker(srv.host, srv.port, stream="chaos")
+        broker.enqueue("a", b"payload-a")
+        # kill the client's socket under it: the next call sees a
+        # connection error, reconnects, and succeeds
+        broker._conn()._sock.close()
+        broker.enqueue("b", b"payload-b")
+        got = dict(broker.claim_batch(10, 1.0))
+        assert got == {"a": b"payload-a", "b": b"payload-b"}
+        broker._conn()._sock.close()
+        # claimed-but-unacked entries net out of pending(); the call still
+        # exercises reconnect (XLEN/XPENDING over a fresh socket)
+        assert broker.pending() == 0
+        broker.put_result("a", b"ra")
+        assert broker.get_result("a", 5.0) == b"ra"
+        broker.close()
+    finally:
+        srv.stop()
+
+
+def test_redis_broker_injected_connect_fault_retried():
+    """broker.connect chaos: the first (re)connect raises an injected
+    ConnectionError; the retry policy absorbs it."""
+    from analytics_zoo_tpu.serving import MiniRedisServer, RedisBroker
+
+    srv = MiniRedisServer().start()
+    try:
+        broker = RedisBroker(srv.host, srv.port, stream="chaos2")
+        broker._conn()._sock.close()
+        with faults.inject("broker.connect", count=1,
+                           kind="connection"):
+            broker.enqueue("x", b"v")   # reconnect fails once, then lands
+        assert broker.pending() == 1
+        broker.close()
+    finally:
+        srv.stop()
+
+
+def test_ckpt_blob_io_fault_retried(tmp_path):
+    """An injected transient blob-IO failure is retried by the plane's
+    RetryPolicy; the checkpoint still commits and restores."""
+    from analytics_zoo_tpu.ckpt import CheckpointPlane
+
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    state = {"w": np.arange(8, dtype=np.float32)}
+    with faults.inject("ckpt.blob_io", count=1):
+        plane.save(state, 1)
+    _, got = plane.restore()
+    np.testing.assert_array_equal(got["w"], state["w"])
+    plane.close()
+
+
+# --------------------------------------------------------------------------
+# preemption watcher
+# --------------------------------------------------------------------------
+
+def test_frontend_sigterm_graceful_exit():
+    """Regression: run_frontend must own SIGTERM (aiohttp's run_app would
+    otherwise install its own handler AFTER the drain watcher, silently
+    replacing it). A SIGTERM to a live frontend drains and exits 0."""
+    import socket
+    import subprocess
+    import sys
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+         "from analytics_zoo_tpu.serving.http_frontend import run_frontend\n"
+         f"run_frontend(queue='memory://sigterm_t', host='127.0.0.1', "
+         f"port={port})"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            try:
+                if urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=1).status == 200:
+                    break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"frontend never came up: "
+                f"{proc.stdout.read().decode(errors='replace')[-2000:]}")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 0, out.decode(errors="replace")[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_nested_preemption_watchers_restore_handlers():
+    """Regression: nested watchers must unwind to exactly the handler
+    chain they found (inner exit restores outer's handler, outer exit
+    restores the original)."""
+    from analytics_zoo_tpu.orca.learn.preemption import PreemptionWatcher
+
+    orig = signal.getsignal(signal.SIGTERM)
+    outer = PreemptionWatcher()
+    with outer:
+        outer_handler = signal.getsignal(signal.SIGTERM)
+        assert outer_handler is not orig
+        inner = PreemptionWatcher()
+        with inner:
+            assert signal.getsignal(signal.SIGTERM) is not outer_handler
+        assert signal.getsignal(signal.SIGTERM) is outer_handler
+    assert signal.getsignal(signal.SIGTERM) is orig
+
+
+def test_preemption_on_signal_callback_shared_entry_point():
+    """on_signal fires once on the first signal — the entry point the
+    serving drain path and the training supervisor share."""
+    from analytics_zoo_tpu.orca.learn.preemption import PreemptionWatcher
+
+    got = []
+    with PreemptionWatcher(on_signal=got.append) as w:
+        signal.raise_signal(signal.SIGTERM)
+        deadline = time.time() + 2.0
+        while not w.triggered and time.time() < deadline:
+            time.sleep(0.01)
+        assert w.triggered
+    assert got == [signal.SIGTERM]
+
+
+def test_preemption_on_signal_error_does_not_crash():
+    from analytics_zoo_tpu.orca.learn.preemption import PreemptionWatcher
+
+    def bad(signum):
+        raise RuntimeError("callback bug")
+
+    with PreemptionWatcher(on_signal=bad) as w:
+        signal.raise_signal(signal.SIGTERM)
+        deadline = time.time() + 2.0
+        while not w.triggered and time.time() < deadline:
+            time.sleep(0.01)
+        assert w.triggered              # flag latched despite the bug
