@@ -251,6 +251,16 @@ def read_manifest(ckpt_dir: str) -> Dict:
     return doc
 
 
+def manifest_meta(ckpt_dir: str) -> Dict:
+    """The caller-supplied ``meta`` dict a checkpoint's manifest carries —
+    provenance readable WITHOUT loading any blob. The estimator records the
+    writing run's comms plane here (``meta["comms"]``: sharded_update,
+    wire_dtype, bucket layout signature), the training supervisor its epoch
+    boundary — a reader can tell how a checkpoint was produced before
+    deciding to adopt it."""
+    return read_manifest(ckpt_dir).get("meta", {}) or {}
+
+
 def load_checkpoint_dir(ckpt_dir: str, passphrase: Optional[str] = None):
     """Read one checkpoint directory back into its state pytree.
 

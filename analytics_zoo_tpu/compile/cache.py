@@ -111,12 +111,16 @@ class CachedFunction:
     process, or from disk)."""
 
     def __init__(self, cache: "ExecutableCache", fn: Callable,
-                 label: str = "", donate_argnums: Tuple[int, ...] = ()):
+                 label: str = "", donate_argnums: Tuple[int, ...] = (),
+                 extra_key: Optional[str] = None):
         self._cache = cache
         self._fn = fn
         self.label = label
         self._uid = next(_uid_counter)
         self._donate = tuple(donate_argnums)
+        # caller-supplied structural salt (e.g. the engine's comms bucket
+        # layout): identity the lowered text alone might not capture
+        self._extra_key = extra_key
         self._local: Dict = {}       # sig -> executable (per-callsite fast path)
         self._keyinfo: Dict = {}     # sig -> (key, lowered) awaiting compile
         self._plain = None
@@ -149,7 +153,8 @@ class CachedFunction:
             return info[0]
         try:
             lowered = self._fresh_jit().lower(*args)
-            key = self._cache.key_of(lowered, self._donate, args)
+            key = self._cache.key_of(lowered, self._donate, args,
+                                     extra_key=self._extra_key)
         except Exception as e:  # noqa: BLE001 — untraceable fn
             logger.debug("cache_key lowering failed (%s: %s)",
                          type(e).__name__, e)
@@ -258,7 +263,8 @@ class ExecutableCache:
                 logger.debug("compile-plane listener failed", exc_info=True)
 
     # --- keying -------------------------------------------------------------
-    def key_of(self, lowered, donate_argnums, args) -> str:
+    def key_of(self, lowered, donate_argnums, args,
+               extra_key: Optional[str] = None) -> str:
         import jax
         import jaxlib
         h = hashlib.sha256()
@@ -267,13 +273,20 @@ class ExecutableCache:
                        jax.default_backend(), tuple(donate_argnums),
                        _arg_devices(jax.tree_util.tree_leaves(args)),
                        _DISK_FORMAT)).encode())
+        if extra_key is not None:
+            # appended only when set, so pre-existing persisted executables
+            # (keyed before extra_key existed) stay valid for every caller
+            # that does not use one
+            h.update(repr(extra_key).encode())
         return h.hexdigest()
 
     # --- the wrap/obtain protocol ------------------------------------------
     def wrap(self, fn: Callable, label: str = "",
-             donate_argnums: Tuple[int, ...] = ()) -> CachedFunction:
+             donate_argnums: Tuple[int, ...] = (),
+             extra_key: Optional[str] = None) -> CachedFunction:
         return CachedFunction(self, fn, label=label,
-                              donate_argnums=donate_argnums)
+                              donate_argnums=donate_argnums,
+                              extra_key=extra_key)
 
     def obtain(self, cf: CachedFunction, args, sig, keyinfo=None):
         """Resolve the executable for one call signature: shared memory
@@ -283,7 +296,8 @@ class ExecutableCache:
         else:
             try:
                 lowered = cf._fresh_jit().lower(*args)
-                key = self.key_of(lowered, cf._donate, args)
+                key = self.key_of(lowered, cf._donate, args,
+                                  extra_key=cf._extra_key)
             except Exception as e:  # noqa: BLE001 — untraceable: plain jit
                 logger.warning(
                     "compile plane cannot lower %r (%s: %s); using plain "

@@ -72,7 +72,8 @@ class TPUEstimator:
     def __init__(self, module, loss=None, optimizer="adam", metrics=None,
                  model_dir: Optional[str] = None,
                  config: Optional[dict] = None, seed: int = 0, mesh=None,
-                 fsdp: bool = False, compile_cache=None, prologue=None):
+                 fsdp: bool = False, compile_cache=None, prologue=None,
+                 sharded_update: Optional[bool] = None):
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.module = module
@@ -90,10 +91,19 @@ class TPUEstimator:
         # step so the wire carries narrow source dtypes (uint8/int32)
         if prologue is None:
             prologue = self.config.get("prologue", None)
+        # comms plane (parallel/comms.py): bucketed gradient reduce-scatter
+        # + ZeRO-1 sharded weight update + quantized wire. Knobs:
+        # ``sharded_update`` arg / config key / ZOO_SHARDED_UPDATE,
+        # config ``grad_bucket_mb`` / ZOO_GRAD_BUCKET_MB,
+        # config ``allreduce_dtype`` / ZOO_ALLREDUCE_DTYPE (f32|bf16|int8).
+        # All-default means OFF: the engine's step stays the pre-plane
+        # GSPMD program, bit for bit.
+        from ...parallel.comms import CommsConfig
+        comms = CommsConfig.resolve(self.config, sharded_update)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
                                   self.mesh, seed=seed, fsdp_params=fsdp,
                                   compile_cache=compile_cache,
-                                  prologue=prologue)
+                                  prologue=prologue, comms=comms)
         # one stats object spans iterator assembly, the pump's H2D stage and
         # the engine's dispatches — the estimator is where they all meet
         from ...native.infeed import PipelineStats
@@ -163,6 +173,12 @@ class TPUEstimator:
             # (estimated) compile seconds saved, cumulative for the cache
             # this engine compiles through (shared process-wide by default)
             snap["compile"] = self.engine.compile_cache.stats.snapshot()
+        comms = self.engine.comms_snapshot()
+        if comms is not None:
+            # comms-plane accounting (static per-step wire bytes/collective
+            # counts + cumulative steps) — absent when the plane is off so
+            # existing consumers see no new key
+            snap["comms"] = comms
         from ...resilience.stats import resilience_snapshot
         res = resilience_snapshot()
         if res:
@@ -443,9 +459,7 @@ class TPUEstimator:
                     if stored is not None:
                         return int(stored)
             compute_s = learn_utils.estimate_step_compute_s(
-                eng.ensure_jit_train(),
-                (eng.params, eng.extra_vars, eng.opt_state,
-                 jnp.asarray(eng.step), b0.x, b0.y, b0.w),
+                eng.ensure_jit_train(), eng.train_step_args(b0),
                 list(self.mesh.devices.flat))
             if compute_s is not None and compute_s >= 0.01:
                 # compute-dominated: nothing worth amortizing
@@ -846,6 +860,12 @@ class TPUEstimator:
         rides the manifest (the training supervisor records its epoch
         boundary there)."""
         plane = self._ckpt(model_dir)
+        comms_meta = self.engine.comms_manifest_meta()
+        if comms_meta is not None:
+            # record the writing run's comms plane in the manifest (the
+            # opt state itself is stored in canonical tree form, so the
+            # meta is provenance, not a format switch)
+            meta = {**(meta or {}), "comms": comms_meta}
         path = plane.save(self.engine.get_state(), self.engine.step,
                           score=self._trainer_state.score,
                           meta=meta, blocking=blocking)

@@ -1,5 +1,6 @@
+from .comms import BucketLayout, CommsConfig, CommsPlan
 from .mesh import (batch_divisor, create_mesh, data_sharding,
-                   mesh_axis_size, replicated, resolve_axis_sizes)
+                   mesh_axis_size, pure_dp, replicated, resolve_axis_sizes)
 from .expert_parallel import (expert_sharding, moe_apply,
                               stack_expert_params)
 from .pipeline_parallel import (pipeline_apply, stack_stage_params,
@@ -8,7 +9,8 @@ from .tensor_parallel import (TPDense, TPMLP, TPSelfAttention,
                               TPTransformerBlock)
 
 __all__ = ["create_mesh", "data_sharding", "replicated", "resolve_axis_sizes",
-           "mesh_axis_size", "batch_divisor", "TPDense", "TPMLP",
+           "mesh_axis_size", "batch_divisor", "pure_dp", "BucketLayout",
+           "CommsConfig", "CommsPlan", "TPDense", "TPMLP",
            "TPSelfAttention", "TPTransformerBlock", "pipeline_apply",
            "stack_stage_params", "stage_sharding", "moe_apply",
            "stack_expert_params", "expert_sharding"]

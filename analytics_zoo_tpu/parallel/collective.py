@@ -50,11 +50,34 @@ def axis_size(axis: AxisName = "dp"):
     return _axis_size(axis)
 
 
+def axis_bound(axis: str) -> bool:
+    """True when ``axis`` is bound in the ambient mapped context (shard_map
+    / pmap). Probing costs nothing: the size query constant-folds, and an
+    unbound name raises instead of emitting a collective."""
+    try:
+        axis_size(axis)
+        return True
+    except (NameError, KeyError, ValueError, TypeError):
+        return False
+
+
 def grad_allreduce_mean(grads: Any, axes: Sequence[str] = ("dp", "fsdp")) -> Any:
     """Mean-reduce gradients over the data axes — the one-liner that replaces
     BigDL's AllReduceParameter push/pull cycle (reference:
-    zoo/.../keras/models/Topology.scala:1203-1206, docs/docs/wp-bigdl.md:140-160)."""
+    zoo/.../keras/models/Topology.scala:1203-1206, docs/docs/wp-bigdl.md:140-160).
+
+    Axis names absent from the ambient mesh are skipped, so the default
+    ``("dp", "fsdp")`` works unchanged inside a single-axis
+    ``Mesh(devices, ("dp",))`` shard_map (reducing over an unbound name
+    used to raise). Calling with NO bound axis at all still raises —
+    silently returning unreduced gradients would let replicas diverge."""
+    bound = [ax for ax in axes if axis_bound(ax)]
+    if axes and not bound:
+        raise NameError(
+            f"grad_allreduce_mean: none of the axes {tuple(axes)} are "
+            "bound in the ambient mesh — call it inside shard_map/pmap "
+            "over at least one of them")
     out = grads
-    for ax in axes:
+    for ax in bound:
         out = lax.pmean(out, axis_name=ax)
     return out

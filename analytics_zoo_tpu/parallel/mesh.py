@@ -105,6 +105,14 @@ def mesh_axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 
 
+def pure_dp(mesh: Mesh, axis: str = "dp") -> bool:
+    """True when ``axis`` is the only non-trivial mesh axis — the regime
+    the comms plane (parallel/comms.py) owns: params replicated, batch
+    split over ``axis``, every collective explicit."""
+    return all(size == 1 for name, size in mesh.shape.items()
+               if name != axis)
+
+
 def batch_divisor(mesh: Mesh) -> int:
     """Global batch must be a multiple of this (the TPU analogue of the
     reference's node_num*core_num rule, pyzoo/zoo/tfpark/tf_dataset.py:135-149)."""

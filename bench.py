@@ -1105,6 +1105,155 @@ def bench_infeed(smoke: bool) -> dict:
             "batch": batch, "n": n, "image_side": side}
 
 
+def _comms_child(smoke: bool) -> dict:
+    """Runs inside the 8-device simulated CPU mesh subprocess: flat-psum
+    vs bucketed reduce-scatter vs quantized wire through the production
+    estimator, reporting collective launches (counted in the lowered
+    StableHLO), grad wire bytes/step, and bit-identity."""
+    import re
+
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+    width = 32 if smoke else 64
+    depth = 6 if smoke else 8
+    n = 512 if smoke else 2048
+    epochs = 2 if smoke else 3
+
+    class DeepMLP(nn.Module):
+        # many small leaves on purpose: the flat wire pays one collective
+        # per leaf, which is exactly what bucketing amortizes
+        @nn.compact
+        def __call__(self, x):
+            for _ in range(depth):
+                x = nn.relu(nn.Dense(width)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    data = {"x": rng.rand(n, 16).astype(np.float32),
+            "y": rng.rand(n).astype(np.float32)}
+
+    def run(cfg, **kw):
+        est = TPUEstimator(DeepMLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1, **cfg}, **kw)
+        it = data_to_iterator(dict(data), 64, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        b0 = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in b0.x))
+        fn = est.engine.ensure_jit_train()
+        text = fn.lower(*est.engine.train_step_args(b0)).as_text()
+        collectives = len(re.findall(
+            r"stablehlo\.(?:all_reduce|reduce_scatter|all_gather|"
+            r"collective_permute)", text))
+        # warm the executable with one rolled-back step so the timed fit
+        # measures steady-state step rate, not each leg's JIT compile
+        # (the snapshot copies survive the step's buffer donation)
+        snap = est.engine.snapshot()
+        fn(*est.engine.train_step_args(b0))
+        est.engine.restore_snapshot(snap)
+        t0 = time.perf_counter()
+        stats = est.fit(dict(data), epochs=epochs, batch_size=64,
+                        verbose=False)
+        dt = time.perf_counter() - t0
+        snap = est.data_pipeline_stats().get("comms", {})
+        weights = np.concatenate(
+            [np.asarray(l).ravel() for l in
+             jax.tree_util.tree_leaves(est.engine.params)])
+        return {"losses": [s["train_loss"] for s in stats],
+                "weights": weights, "collectives": collectives,
+                "steps_per_s": round(snap.get("steps", 0) / max(dt, 1e-9),
+                                     1),
+                "comms": snap}
+
+    flat = run({"comms_plane": True})
+    bucketed = run({"grad_bucket_mb": 4.0})
+    sharded = run({"grad_bucket_mb": 4.0}, sharded_update=True)
+    bf16 = run({"grad_bucket_mb": 4.0, "allreduce_dtype": "bf16"})
+
+    reduction = flat["collectives"] / max(bucketed["collectives"], 1)
+    wire = bf16["comms"]
+    wire_reduction = wire["grad_bytes_f32"] / wire["wire_bytes_per_step"]
+    drift = float(np.abs(np.asarray(bf16["losses"])
+                         - np.asarray(bucketed["losses"])).max())
+    out = {
+        "metric": "comms_collective_launch_reduction",
+        "value": round(reduction, 2), "unit": "x",
+        # no reference baseline (the reference allreduced per parameter
+        # block through the Spark block manager) — the reduction IS the
+        # vs-baseline signal
+        "vs_baseline": round(reduction, 2),
+        "bit_identical": bool(
+            flat["losses"] == bucketed["losses"]
+            and (flat["weights"] == bucketed["weights"]).all()),
+        "sharded_bit_identical": bool(
+            sharded["losses"] == bucketed["losses"]
+            and (sharded["weights"] == bucketed["weights"]).all()),
+        "collectives_per_step_flat": flat["collectives"],
+        "collectives_per_step_bucketed": bucketed["collectives"],
+        "grad_bytes_per_step_f32": wire["grad_bytes_f32"],
+        "wire_bytes_per_step_bf16": wire["wire_bytes_per_step"],
+        "wire_byte_reduction_bf16": round(wire_reduction, 2),
+        "bf16_loss_drift": drift,
+        "buckets": bucketed["comms"].get("buckets"),
+        "opt_shard_elems": sharded["comms"].get("opt_shard_elems"),
+        "opt_full_elems": sharded["comms"].get("opt_full_elems"),
+        "steps_per_s": {"flat": flat["steps_per_s"],
+                        "bucketed": bucketed["steps_per_s"],
+                        "sharded": sharded["steps_per_s"],
+                        "bf16": bf16["steps_per_s"]},
+        "grad_leaves": flat["comms"].get("grad_leaves"),
+        "dp": 8, "model_depth": depth, "model_width": width,
+    }
+    return out
+
+
+def bench_comms(smoke: bool) -> dict:
+    """Comms-plane microbench (PR 8): flat per-leaf psum vs bucketed
+    reduce-scatter+all-gather vs the quantized bf16 wire, plus the ZeRO-1
+    sharded update, on a SIMULATED 8-device CPU mesh.
+
+    The bench process may own a real TPU (or a 1-device CPU backend), and
+    the device count is fixed at jax import — so the mesh runs in a
+    subprocess with ``xla_force_host_platform_device_count=8``. CI gates
+    on: bucketed bit-identical to flat psum, >=2x fewer collective
+    launches, >=1.9x fewer grad wire bytes with bf16, sharded update
+    bit-identical (.github/workflows/tier1.yml).
+    """
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the child configures each leg explicitly — ambient comms knobs would
+    # contaminate the flat baseline (ZOO_GRAD_BUCKET_MB=4 in the caller's
+    # shell must not turn the "flat" leg into a bucketed one)
+    for knob in ("ZOO_GRAD_BUCKET_MB", "ZOO_SHARDED_UPDATE",
+                 "ZOO_ALLREDUCE_DTYPE", "ZOO_ALLREDUCE_BLOCK",
+                 "ZOO_COMMS_PLANE"):
+        env.pop(knob, None)
+    # force the count — an ambient =4 from the caller's shell would run the
+    # mesh at dp=4 while the output and the tier1 gate assume dp=8
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_comms_child",
+         "1" if smoke else "0"],
+        env=env, capture_output=True, text=True, timeout=900)
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"comms child failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip()[-2000:]}")
+    return json.loads(lines[-1])
+
+
 def bench_ckpt(smoke: bool) -> dict:
     """Checkpoint-plane microbench: async save stall vs the blocking write
     at NCF scale, dedup ratio, atomic-commit crash resume.
@@ -1513,6 +1662,13 @@ def _force_cpu_backend(jax):
 
 
 def main():
+    if "--_comms_child" in sys.argv:
+        # bench_comms' simulated-mesh subprocess: no context fallback, no
+        # other workloads — one JSON line on stdout
+        pos = sys.argv.index("--_comms_child") + 1
+        smoke = pos < len(sys.argv) and sys.argv[pos] == "1"
+        print(json.dumps(_comms_child(smoke)))
+        return
     _init_context_cpu_fallback()
     if "--real-host" in sys.argv:
         sys.exit(bench_real_host())
@@ -1536,7 +1692,7 @@ def main():
                "serving_od": bench_serving_od, "attention": bench_attention,
                "compile_plane": bench_compile_plane,
                "infeed": bench_infeed, "ckpt": bench_ckpt,
-               "resilience": bench_resilience}
+               "comms": bench_comms, "resilience": bench_resilience}
     # smoke runs must never clobber full-run artifacts (vs_baseline on a
     # reduced workload against a full-scale baseline is meaningless)
     detail_name = "BENCH_DETAIL_SMOKE.json" if smoke else "BENCH_DETAIL.json"
@@ -1579,7 +1735,8 @@ def main():
                       ("attention", "flash_attention_speedup"),
                       ("compile_plane", "compile_warm_start"),
                       ("infeed", "infeed_wire_reduction"),
-                      ("ckpt", "ckpt_async_hiding")):
+                      ("ckpt", "ckpt_async_hiding"),
+                      ("comms", "comms_collective_reduction")):
         r = detail.get(name, {})
         if r and "error" not in r:
             out[f"{key}_value"] = r["value"]

@@ -91,6 +91,46 @@ keys = ("saves", "stall_s", "hidden_s", "write_s", "stall_frac",
         "dedup_ratio", "bytes_written", "bytes_deduped")
 print("CKPT_PLANE=" + json.dumps({k: snap[k] for k in keys if k in snap}))
 EOF
+# comms-plane snapshot: bucketed reduce-scatter + ZeRO-1 sharded update on
+# the 8-device simulated mesh — buckets, wire bytes/step, collective
+# launches, sharded on/off, bit-identity to flat psum
+# (never affects the exit code)
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'EOF' 2>/dev/null || true
+import json
+import numpy as np
+import flax.linen as nn
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+
+init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(32)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x)[:, 0]
+
+rng = np.random.RandomState(0)
+data = {"x": rng.rand(256, 8).astype(np.float32),
+        "y": rng.rand(256).astype(np.float32)}
+
+def run(cfg, **kw):
+    est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
+                       config={"steps_per_dispatch": 1, **cfg}, **kw)
+    stats = est.fit(dict(data), epochs=1, batch_size=32, verbose=False)
+    return [s["train_loss"] for s in stats], est
+
+lf, _ = run({"comms_plane": True})
+lb, est = run({"grad_bucket_mb": 4.0}, sharded_update=True)
+snap = est.data_pipeline_stats()["comms"]
+keys = ("buckets", "collectives_per_step", "wire_bytes_per_step",
+        "grad_leaves", "sharded_update", "wire_dtype", "opt_shard_elems")
+out = {k: snap[k] for k in keys if k in snap}
+out["bit_identical_to_flat"] = lf == lb
+print("COMMS_PLANE=" + json.dumps(out))
+EOF
 # resilience-plane snapshot: one injected mid-fit fault through the
 # training supervisor + a shed/breaker pass through the serving engine
 # (never affects the exit code)

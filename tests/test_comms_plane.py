@@ -1,0 +1,499 @@
+"""Comms plane (PR 8): bucketed gradient reduce-scatter, ZeRO-1 sharded
+weight update, quantized allreduce wire (parallel/comms.py + engine).
+
+Numerics contract under test, on the 8-device f32 CPU mesh:
+
+* bucket assembly/disassembly round-trips the grad pytree bit-exactly;
+* within the comms plane, flat-psum == bucketed == sharded_update, all
+  bit-identical (reduce_scatter+all_gather is the same per-element N-sum
+  as psum; the optax update is elementwise, so sharding it changes
+  nothing — arXiv:2004.13336);
+* the default path (plane off) is byte-for-byte the pre-plane GSPMD step;
+* the quantized wire's error-feedback residual bounds drift over 50 steps;
+* sharded and unsharded runs read each other's checkpoints;
+* the compile-plane key misses when the bucket layout changes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+from analytics_zoo_tpu.parallel.comms import (BucketLayout, CommsConfig,
+                                              CommsPlan, build_layout)
+
+
+class MLP(nn.Module):
+    """Several small leaves on purpose — bucketing exists for trees where
+    per-leaf collectives dominate."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(32)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x)[:, 0]
+
+
+def _data(n=256, d=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(n, d).astype(np.float32),
+            "y": rng.rand(n).astype(np.float32)}
+
+
+def _fit(cfg, epochs=2, seed=0, data=None, model_dir=None, fuse=1, **kw):
+    est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=seed,
+                       model_dir=model_dir,
+                       config={"steps_per_dispatch": fuse, **cfg}, **kw)
+    stats = est.fit(dict(data or _data()), epochs=epochs, batch_size=32,
+                    verbose=False)
+    return [s["train_loss"] for s in stats], est
+
+
+def _flat_params(est):
+    return np.concatenate([np.asarray(l).ravel() for l in
+                           jax.tree_util.tree_leaves(est.engine.params)])
+
+
+def _flat_tree(tree):
+    return np.concatenate([np.asarray(l).ravel() for l in
+                           jax.tree_util.tree_leaves(tree)]) \
+        if jax.tree_util.tree_leaves(tree) else np.zeros(0)
+
+
+# ---------------------------------------------------------------------------
+# bucket layout
+# ---------------------------------------------------------------------------
+def _random_tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"a": {"kernel": rng.randn(7, 5).astype(np.float32),
+                  "bias": rng.randn(5).astype(np.float32)},
+            "b": [rng.randn(3, 3, 2).astype(np.float32),
+                  rng.randn(1).astype(np.float32)],
+            "c": rng.randn(131).astype(np.float32)}
+
+
+def test_bucket_round_trip_bit_exact(orca_context):
+    tree = _random_tree()
+    cfg = CommsConfig(bucket_mb=0.0005)      # tiny buckets -> several
+    lo = build_layout(tree, 8, cfg)
+    assert len(lo.bucket_sizes) > 1
+    assert all(b % 8 == 0 for b in lo.bucket_sizes)
+    assert lo.padded_total == sum(lo.bucket_sizes) == 8 * lo.shard_size
+
+    flat = lo.flatten(tree)
+    back = lo.unflatten(flat)
+    for a, b in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(back)):
+        assert a.dtype == np.asarray(b).dtype
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+    # bucket split/join and the scattered (replica-major) order round-trip
+    assert (np.asarray(lo.unbuckets(lo.buckets(flat))) ==
+            np.asarray(flat)).all()
+    scat = lo.to_scattered(flat)
+    assert (np.asarray(lo.from_scattered(scat)) == np.asarray(flat)).all()
+    # numpy twins agree with the jnp versions bit-for-bit
+    assert (lo.flatten_np(tree) == np.asarray(flat)).all()
+    assert (lo.to_scattered_np(np.asarray(flat)) == np.asarray(scat)).all()
+    assert (lo.from_scattered_np(np.asarray(scat)) ==
+            np.asarray(flat)).all()
+
+
+def test_layout_deterministic_and_int8_alignment(orca_context):
+    tree = _random_tree()
+    cfg = CommsConfig(bucket_mb=0.0005)
+    assert build_layout(tree, 8, cfg).signature() == \
+        build_layout(tree, 8, cfg).signature()
+    # a different bucket size is a different layout identity
+    assert build_layout(tree, 8, CommsConfig(bucket_mb=0.001)).signature() \
+        != build_layout(tree, 8, cfg).signature()
+    # int8 buckets must also split into whole scale blocks
+    lo8 = build_layout(tree, 8, CommsConfig(bucket_mb=0.0005,
+                                            wire_dtype="int8", block=64))
+    assert all(b % 64 == 0 and b % 8 == 0 for b in lo8.bucket_sizes)
+
+
+def test_non_f32_leaf_rejected(orca_context):
+    # the plane's bit-identity / lossless-round-trip contracts are f32-only:
+    # ints AND narrow floats (whose moments would truncate through the f32
+    # flat vector) are rejected up front
+    for bad in (np.ones(4, np.int32), np.ones(4, np.float16)):
+        with pytest.raises(ValueError, match="f32"):
+            build_layout({"w": bad}, 8, CommsConfig(explicit=True))
+
+
+# ---------------------------------------------------------------------------
+# satellite: grad_allreduce_mean on a single-axis mesh
+# ---------------------------------------------------------------------------
+def test_grad_allreduce_mean_skips_absent_axes(orca_context):
+    """Regression: the default ``axes=("dp", "fsdp")`` used to raise inside
+    any mesh that does not bind an ``fsdp`` axis (e.g. a user's 1-D
+    ``Mesh(devices, ("dp",))``)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from analytics_zoo_tpu.parallel import collective as C
+    from analytics_zoo_tpu.parallel._compat import shard_map
+
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = jax.jit(shard_map(lambda v: C.grad_allreduce_mean(v),
+                            mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp")))(x)
+    np.testing.assert_array_equal(np.asarray(out), np.full((8, 1), 3.5))
+    # but NO bound axis at all still fails loudly — a silent no-op would
+    # let replicas diverge
+    with pytest.raises(NameError, match="none of the axes"):
+        jax.jit(lambda v: C.grad_allreduce_mean(v))(x)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity within the plane
+# ---------------------------------------------------------------------------
+def test_default_path_stays_off_and_deterministic(orca_context):
+    """All-default config keeps the comms plane OFF — the engine runs the
+    exact pre-plane GSPMD step (same arg signature, no residual, no
+    telemetry key) and is deterministic per seed."""
+    from analytics_zoo_tpu.orca.learn.engine import TrainEngine
+    l0, e0 = _fit({})
+    l1, e1 = _fit({})
+    assert e0.engine.comms is None and e0.engine.comms_cfg is None
+    assert e0.engine.comms_resid is None
+    assert "comms" not in e0.data_pipeline_stats()
+    # the executable IS the pre-plane step function — the plane never
+    # rewires the default path, so per-seed weights cannot move
+    wrapped = getattr(e0.engine._jit_train, "_fn", None)
+    if wrapped is not None:             # compile plane on: inspectable
+        assert wrapped.__func__ is TrainEngine._train_step
+    assert l0 == l1
+    assert (_flat_params(e0) == _flat_params(e1)).all()
+
+
+def test_bucketed_bit_identical_to_flat_psum(orca_context):
+    lf, ef = _fit({"comms_plane": True})
+    lb, eb = _fit({"grad_bucket_mb": 0.001})
+    assert ef.engine.comms is not None
+    assert ef.engine.comms.cfg.effective_bucket_mb == 0      # leafwise wire
+    assert len(eb.engine.comms.layout.bucket_sizes) > 1
+    assert lf == lb
+    assert (_flat_params(ef) == _flat_params(eb)).all()
+
+
+def test_sharded_update_bit_identical_to_unsharded(orca_context):
+    lb, eb = _fit({"grad_bucket_mb": 0.001})
+    ls, es = _fit({"grad_bucket_mb": 0.001}, sharded_update=True)
+    assert ls == lb
+    assert (_flat_params(eb) == _flat_params(es)).all()
+    # the optimizer moment trees agree too (checkpoint/canonical form)
+    ob = _flat_tree(eb.engine.get_state()["opt_state"])
+    os_ = _flat_tree(es.engine.get_state()["opt_state"])
+    assert (ob == os_).all()
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adamw"])
+def test_sharded_bit_identity_other_optimizers_and_padded_tail(
+        orca_context, opt):
+    """The elementwise-update argument holds for every optax transform we
+    ship (momentum SGD, decoupled weight decay, ...), including batches
+    with a padded tail (per-example weights in the loss)."""
+    data = _data(n=200)                 # 200 % 48 != 0 -> padded last batch
+
+    def run(shard):
+        est = TPUEstimator(MLP(), loss="mse", optimizer=opt, seed=0,
+                           config={"steps_per_dispatch": 1,
+                                   "grad_bucket_mb": 0.001},
+                           sharded_update=shard)
+        stats = est.fit(dict(data), epochs=2, batch_size=48, verbose=False)
+        return [s["train_loss"] for s in stats], _flat_params(est)
+
+    lb, wb = run(False)
+    ls, ws = run(True)
+    assert lb == ls
+    assert (wb == ws).all()
+
+
+def test_sharded_update_fused_dispatch_bit_identical(orca_context):
+    """The k-fused lax.scan path (train_batch_group) carries the comms
+    step's extra state (resid slot) through the carry unchanged."""
+    l1, e1 = _fit({"grad_bucket_mb": 0.001}, sharded_update=True, fuse=1)
+    l4, e4 = _fit({"grad_bucket_mb": 0.001}, sharded_update=True, fuse=4)
+    assert np.allclose(l1, l4, rtol=0, atol=0)
+    assert (_flat_params(e1) == _flat_params(e4)).all()
+
+
+def test_clipping_matches_between_sharded_and_unsharded(orca_context):
+    """Norm clipping computes its scale from the reduce-scattered shards in
+    BOTH update modes, so sharding cannot move the clip threshold."""
+    def clipped(shard):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1,
+                                   "grad_bucket_mb": 0.001},
+                           sharded_update=shard)
+        est.set_l2_norm_gradient_clipping(0.05)
+        stats = est.fit(dict(_data()), epochs=2, batch_size=32,
+                        verbose=False)
+        return [s["train_loss"] for s in stats], _flat_params(est)
+
+    lb, wb = clipped(False)
+    ls, ws = clipped(True)
+    assert lb == ls
+    assert (wb == ws).all()
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 memory: optimizer state HBM per replica shrinks by the dp degree
+# ---------------------------------------------------------------------------
+def test_sharded_opt_state_is_sharded_over_dp(orca_context):
+    _, es = _fit({"grad_bucket_mb": 0.001}, sharded_update=True)
+    lo = es.engine.comms.layout
+    moments = [l for l in jax.tree_util.tree_leaves(es.engine.opt_state)
+               if getattr(l, "ndim", 0) == 1
+               and l.shape[0] == lo.padded_total]
+    assert len(moments) >= 2            # adam mu + nu
+    for leaf in moments:
+        shard_shape = leaf.addressable_shards[0].data.shape
+        assert shard_shape == (lo.padded_total // 8,)
+        assert "dp" in str(leaf.sharding.spec)
+    # vs the unsharded run, whose moments replicate the full vector
+    _, eb = _fit({"grad_bucket_mb": 0.001})
+    full = [l for l in jax.tree_util.tree_leaves(eb.engine.opt_state)
+            if getattr(l, "ndim", 0) >= 1]
+    for leaf in full:
+        assert leaf.addressable_shards[0].data.shape == leaf.shape
+
+
+# ---------------------------------------------------------------------------
+# quantized wire + error feedback
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_quantized_wire_error_feedback_bounds_drift(orca_context, wire):
+    data = _data(n=128)
+    steps = 50
+    epochs = -(-steps * 32 // 128)      # >= 50 optimizer steps
+    le, ee = _fit({"grad_bucket_mb": 0.001}, epochs=epochs, data=data)
+    lq, eq = _fit({"grad_bucket_mb": 0.001, "allreduce_dtype": wire,
+                   "allreduce_block": 64}, epochs=epochs, data=data)
+    assert eq.engine.comms_steps >= steps
+    # the EF residual is alive (quantization error is being carried)
+    resid = np.asarray(eq.engine.comms_resid)
+    assert resid.shape == (8, eq.engine.comms.layout.padded_total)
+    assert np.abs(resid).max() > 0
+    # drift stays bounded: the compressed run tracks the exact run's loss
+    # trajectory and does not diverge over 50 steps
+    le, lq = np.asarray(le), np.asarray(lq)
+    assert np.all(np.abs(lq - le) <= 5e-3 * np.maximum(np.abs(le), 1e-3))
+    assert np.abs(lq[-1] - le[-1]) <= 2e-3 * max(abs(le[-1]), 1e-3)
+    # wire accounting: bf16 halves the f32 grad bytes, int8 quarters them
+    # (modulo per-block scales and bucket padding)
+    snap = eq.data_pipeline_stats()["comms"]
+    ratio = snap["grad_bytes_f32"] / snap["wire_bytes_per_step"]
+    assert ratio >= (1.9 if wire == "bf16" else 3.0)
+
+
+def test_quantize_wire_helper(orca_context):
+    from analytics_zoo_tpu.parallel.comms import quantize_wire
+    x = jnp.asarray(np.random.RandomState(0).randn(512).astype(np.float32))
+    assert (np.asarray(quantize_wire(x, "f32", 64)) == np.asarray(x)).all()
+    b = np.asarray(quantize_wire(x, "bf16", 64))
+    assert np.abs(b - np.asarray(x)).max() <= 0.01 * np.abs(x).max()
+    q = np.asarray(quantize_wire(x, "int8", 64))
+    # block-scaled int8: error bounded by half a quantization step per block
+    blocks = np.asarray(x).reshape(-1, 64)
+    scales = np.abs(blocks).max(1, keepdims=True) / 127.0
+    assert np.all(np.abs(q.reshape(-1, 64) - blocks) <= scales * 0.5 + 1e-7)
+    # an all-zero block must not divide by zero
+    z = np.asarray(quantize_wire(jnp.zeros(128), "int8", 64))
+    assert (z == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: sharded <-> unsharded restore round trip
+# ---------------------------------------------------------------------------
+def test_ckpt_sharded_to_unsharded_round_trip(orca_context, tmp_path):
+    data = _data()
+    cfg = {"grad_bucket_mb": 0.001, "ckpt_async": False}
+
+    # reference: one uninterrupted unsharded run, 4 epochs
+    lref, eref = _fit(cfg, epochs=4, data=data)
+
+    # sharded run for 2 epochs -> checkpoint -> restore into an UNSHARDED
+    # estimator -> 2 more epochs must land on the reference bit-exactly
+    l1, e1 = _fit(cfg, epochs=2, data=data, sharded_update=True)
+    d1 = str(tmp_path / "sharded")
+    e1.save_checkpoint(d1, blocking=True)
+
+    e2 = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                      config={"steps_per_dispatch": 1, **cfg})
+    e2.load_checkpoint(d1)
+    assert e2.engine.step == e1.engine.step
+    l2 = [s["train_loss"] for s in
+          e2.fit(dict(data), epochs=2, batch_size=32, verbose=False,
+                 initial_epoch=2)]
+    assert l1 + l2 == lref
+    assert (_flat_params(e2) == _flat_params(eref)).all()
+
+    # the manifest records the writing run's comms plane
+    from analytics_zoo_tpu.ckpt.format import (loadable_step_dirs,
+                                               manifest_meta)
+    meta = manifest_meta(loadable_step_dirs(d1)[-1][1])
+    assert meta["comms"]["sharded_update"] is True
+    assert meta["comms"]["layout_sig"] == \
+        e1.engine.comms.layout.signature()
+    e1.shutdown()
+    e2.shutdown()
+
+
+def test_ckpt_unsharded_to_sharded_round_trip(orca_context, tmp_path):
+    data = _data()
+    cfg = {"grad_bucket_mb": 0.001, "ckpt_async": False}
+
+    lref, eref = _fit(cfg, epochs=4, data=data, sharded_update=True)
+
+    l1, e1 = _fit(cfg, epochs=2, data=data)          # unsharded writer
+    d1 = str(tmp_path / "unsharded")
+    e1.save_checkpoint(d1, blocking=True)
+
+    e2 = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                      config={"steps_per_dispatch": 1, **cfg},
+                      sharded_update=True)
+    e2.load_checkpoint(d1)
+    # restored straight into the sharded representation
+    lo = e2.engine.comms.layout
+    moments = [l for l in jax.tree_util.tree_leaves(e2.engine.opt_state)
+               if getattr(l, "ndim", 0) == 1
+               and l.shape[0] == lo.padded_total]
+    assert moments and all(
+        m.addressable_shards[0].data.shape == (lo.padded_total // 8,)
+        for m in moments)
+    l2 = [s["train_loss"] for s in
+          e2.fit(dict(data), epochs=2, batch_size=32, verbose=False,
+                 initial_epoch=2)]
+    assert l1 + l2 == lref
+    assert (_flat_params(e2) == _flat_params(eref)).all()
+    e1.shutdown()
+    e2.shutdown()
+
+
+def test_ckpt_restore_unambiguous_param_matching_padded_total(
+        orca_context, tmp_path):
+    """Regression: a single 1-D param of exactly ``padded_total`` elements
+    makes tree-form Adam moments the same shape as the sharded run's flat
+    moment vectors. The restore path must NOT shape-sniff which form it
+    got (it would skip the tree->flat conversion and bind scattered-order
+    slices of flat-order moments — silently permuted); state dicts are
+    canonical tree form unless explicitly marked ``opt_state_form="flat"``."""
+
+    class VecModel(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            w = self.param("w", nn.initializers.normal(0.02), (1024,))
+            return (x @ w.reshape(16, 64)).sum(axis=-1)
+
+    data = _data(d=16)
+    cfg = {"steps_per_dispatch": 1, "grad_bucket_mb": 0.002,
+           "ckpt_async": False}
+
+    def fit(epochs, est=None, initial_epoch=0):
+        if est is None:
+            est = TPUEstimator(VecModel(), loss="mse", optimizer="adam",
+                               seed=0, config=dict(cfg),
+                               sharded_update=True)
+        losses = [s["train_loss"] for s in
+                  est.fit(dict(data), epochs=epochs, batch_size=32,
+                          verbose=False, initial_epoch=initial_epoch)]
+        return losses, est
+
+    lref, eref = fit(4)
+    l1, e1 = fit(2)
+
+    # preconditions that make the shapes ambiguous: the one param IS the
+    # whole padded flat vector, over a genuinely multi-bucket layout
+    # (scattered order != flat order, so a skipped conversion permutes)
+    lo = e1.engine.comms.layout
+    assert lo.total == lo.padded_total == 1024
+    assert len(lo.bucket_sizes) > 1
+    state = e1.engine.get_state()
+    moments = [l for l in jax.tree_util.tree_leaves(state["opt_state"])
+               if getattr(l, "ndim", 0) == 1]
+    assert moments and all(m.shape == (lo.padded_total,) for m in moments)
+
+    d1 = str(tmp_path / "vec")
+    e1.save_checkpoint(d1, blocking=True)
+    e2 = TPUEstimator(VecModel(), loss="mse", optimizer="adam", seed=0,
+                      config=dict(cfg), sharded_update=True)
+    e2.load_checkpoint(d1)
+    l2, _ = fit(2, est=e2, initial_epoch=2)
+    assert l1 + l2 == lref
+    assert (_flat_params(e2) == _flat_params(eref)).all()
+    e1.shutdown()
+    e2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# compile plane: bucket layout is part of the executable identity
+# ---------------------------------------------------------------------------
+def test_compile_key_misses_when_bucket_layout_changes(orca_context):
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    def key_for(bucket_mb):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1,
+                                   "grad_bucket_mb": bucket_mb})
+        it = data_to_iterator(dict(_data()), 32, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        batch = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in batch.x))
+        return est.engine.train_step_cache_key(batch)
+
+    k_small, k_small2, k_big = key_for(0.001), key_for(0.001), key_for(4.0)
+    assert k_small is not None and k_big is not None
+    assert k_small == k_small2          # same layout -> shared executable
+    assert k_small != k_big             # layout change -> compile-key miss
+
+
+# ---------------------------------------------------------------------------
+# telemetry + guards
+# ---------------------------------------------------------------------------
+def test_comms_telemetry_counts(orca_context):
+    _, ef = _fit({"comms_plane": True})
+    _, eb = _fit({"grad_bucket_mb": 0.001}, sharded_update=True)
+    flat, buck = (ef.data_pipeline_stats()["comms"],
+                  eb.data_pipeline_stats()["comms"])
+    assert flat["collectives_per_step"] == flat["grad_leaves"] == 8
+    assert buck["buckets"] >= 2
+    assert buck["collectives_per_step"] == buck["buckets"] + 1
+    assert buck["collectives_per_step"] < flat["collectives_per_step"]
+    assert buck["sharded_update"] is True
+    assert buck["steps"] == eb.engine.comms_steps > 0
+    assert buck["wire_bytes_total"] == \
+        buck["wire_bytes_per_step"] * buck["steps"]
+    assert buck["opt_shard_elems"] * 8 == buck["opt_full_elems"]
+
+
+def test_comms_requires_pure_dp_mesh(orca_context):
+    from analytics_zoo_tpu.parallel.mesh import create_mesh, pure_dp
+    mesh = create_mesh({"dp": 4, "tp": 2})
+    assert not pure_dp(mesh)
+    est = TPUEstimator(MLP(), loss="mse", optimizer="adam", mesh=mesh,
+                       config={"steps_per_dispatch": 1,
+                               "grad_bucket_mb": 1.0})
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        est.fit(dict(_data()), epochs=1, batch_size=32, verbose=False)
+
+
+def test_comms_config_resolve_env(orca_context, monkeypatch):
+    assert not CommsConfig.resolve({}).active
+    monkeypatch.setenv("ZOO_SHARDED_UPDATE", "1")
+    monkeypatch.setenv("ZOO_GRAD_BUCKET_MB", "8")
+    monkeypatch.setenv("ZOO_ALLREDUCE_DTYPE", "bf16")
+    cfg = CommsConfig.resolve({})
+    assert cfg.active and cfg.sharded_update and cfg.bucket_mb == 8.0 \
+        and cfg.wire_dtype == "bf16"
+    # config dict wins over env
+    cfg2 = CommsConfig.resolve({"allreduce_dtype": "f32",
+                                "grad_bucket_mb": 2})
+    assert cfg2.wire_dtype == "f32" and cfg2.bucket_mb == 2.0
+    with pytest.raises(ValueError):
+        CommsConfig(wire_dtype="fp8")
