@@ -23,7 +23,9 @@ if _os.environ.get("JAX_PLATFORMS"):
         _env = _os.environ["JAX_PLATFORMS"]
         if _cfg and "," in _cfg and _cfg != _env:
             _jax.config.update("jax_platforms", _env)
-    except Exception:  # noqa: BLE001 — never block import on config
+    except (ImportError, KeyError, AttributeError, ValueError):
+        # never block import on platform-config reconciliation: jax may be
+        # absent, JAX_PLATFORMS unset, or the config knob missing/invalid
         pass
 
 from .common.config import OrcaConfig, OrcaContext

@@ -1,0 +1,275 @@
+"""Golden program contracts for the bench train steps.
+
+A *program contract* pins what a train step's lowered StableHLO actually
+does on the wire — collective launches by kind, reduce-scatter wire
+bytes/step, the donation set, and how many distinct train executables the
+bench legs compile — next to what the comms plane *declares* through
+``data_pipeline_stats()["comms"]``. The contracts are committed under
+``tests/goldens/`` and diffed in CI, so a comms/compile regression (a
+bucketing change that doubles launches, a donation that silently stops
+happening, an ``extra_key`` change that collapses two layouts onto one
+executable) fails the gate with a readable delta instead of surfacing as
+a bench slowdown five PRs later.
+
+Four legs mirror ``bench.py bench_comms`` on the 8-device simulated mesh:
+
+* ``baseline``          — comms plane off (the pre-plane GSPMD step)
+* ``flat``              — plane on, flat per-leaf-psum reference wire
+* ``bucketed_sharded``  — 4 MiB buckets + ZeRO-1 sharded update
+* ``bucketed_bf16``     — 4 MiB buckets, bf16 collective wire
+
+Regenerate after an *intentional* program change::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m analytics_zoo_tpu.analysis.golden --update
+
+``--check`` (the CI gate) exits 1 on drift and prints one line per
+changed field.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .hlo_lint import HloLinter, collective_counts, parse_collectives
+
+__all__ = ["capture_contracts", "check", "diff_contracts", "golden_path",
+           "load_goldens", "save_goldens"]
+
+GOLDEN_FILE = "program_contracts.json"
+
+# contract legs: name -> (estimator config, estimator kwargs)
+_LEGS = [
+    ("baseline", {}, {}),
+    ("flat", {"comms_plane": True}, {}),
+    ("bucketed_sharded", {"grad_bucket_mb": 4.0}, {"sharded_update": True}),
+    ("bucketed_bf16", {"grad_bucket_mb": 4.0, "allreduce_dtype": "bf16"},
+     {}),
+]
+
+
+def golden_path(root: Optional[str] = None) -> str:
+    if root is None:
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "tests",
+            "goldens")
+    return os.path.join(root, GOLDEN_FILE)
+
+
+def _bench_model():
+    import flax.linen as nn
+
+    class BenchMLP(nn.Module):
+        """Same shape family as the tier-1 comms snapshot: several small
+        Dense leaves so the flat wire pays per-leaf collectives — exactly
+        what bucketing amortizes, exactly where a regression shows."""
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(32)(x))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    return BenchMLP()
+
+
+def _bench_data():
+    import numpy as np
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(256, 8).astype("float32"),
+            "y": rng.rand(256).astype("float32")}
+
+
+def capture_contracts() -> Dict[str, Any]:
+    """Lower every bench leg's train step and measure its contract.
+    Requires the 8-device simulated mesh (tests/conftest.py provides it;
+    the CLI sets XLA_FLAGS itself). Lowering-only — nothing is compiled,
+    so capture is fast and deterministic."""
+    import numpy as np
+
+    from ..common.context import get_context
+    from ..compile.cache import ExecutableCache
+    from ..orca.learn.estimator import TPUEstimator
+    from ..orca.learn.utils import data_to_iterator
+
+    ctx = get_context()
+    dp = int(ctx.mesh.shape.get("dp", 1)) if ctx.mesh is not None else 1
+    if dp < 2:
+        raise RuntimeError(
+            f"golden contracts need a dp>=2 mesh (got dp={dp}); run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8 with "
+            f"init_orca_context('cpu-sim', mesh_axes={{'dp': -1}})")
+
+    data = _bench_data()
+    # one private cache across all legs: distinct layouts MUST yield
+    # distinct executable keys (the compile plane's extra_key contract)
+    cache = ExecutableCache()
+    contracts: Dict[str, Any] = {"dp": dp}
+    train_keys: List[str] = []
+    linter = HloLinter()
+
+    for name, cfg, kwargs in _LEGS:
+        est = TPUEstimator(_bench_model(), loss="mse", optimizer="adam",
+                           seed=0, compile_cache=cache,
+                           config={"steps_per_dispatch": 1, **cfg},
+                           **kwargs)
+        it = data_to_iterator(dict(data), 32, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        b0 = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in b0.x))
+        fn = est.engine.ensure_jit_train()
+        args = est.engine.train_step_args(b0)
+        if hasattr(fn, "cache_key"):
+            # one lower+render serves both the executable key and the
+            # contract text (lowered_text reuses cache_key's lowering)
+            key = fn.cache_key(*args)
+            text = fn.lowered_text(*args)
+        else:
+            key, text = None, None
+        if text is None:
+            text = fn.lower(*args).as_text()
+        if key:
+            train_keys.append(key)
+
+        ops = parse_collectives(text)
+        counts = collective_counts(ops)
+        rs_bytes = sum(op.operand_bytes for op in ops
+                       if op.kind == "reduce_scatter")
+
+        donation = (fn._donate if hasattr(fn, "_donate")
+                    else ((0, 2, 3) if est.engine.comms_resid is not None
+                          else (0, 2)))
+        declared = est.engine.comms_snapshot()
+        entry: Dict[str, Any] = {
+            "collectives": counts,
+            "rs_wire_bytes": int(rs_bytes),
+            "donation": sorted(int(i) for i in donation),
+        }
+        if declared is not None:
+            keep = ("buckets", "collectives_per_step", "wire_bytes_per_step",
+                    "grad_leaves", "sharded_update", "wire_dtype",
+                    "grad_bytes_f32")
+            entry["declared"] = {k: declared[k] for k in keep
+                                 if k in declared}
+            # the accounting rule run right here: measured bytes/launches
+            # vs declared — a contract is only golden when they agree
+            findings = linter.lint_text(text, label=f"golden:{name}",
+                                        declared=declared)
+            entry["accounting_verified"] = not findings
+            entry["accounting_findings"] = [str(f) for f in findings]
+        contracts[name] = entry
+
+    # every leg must map to its own executable: a regression in the
+    # comms fingerprint / extra_key salting collapses this number
+    contracts["distinct_train_executables"] = (
+        len(set(train_keys)) if train_keys else None)
+    return contracts
+
+
+# ---------------------------------------------------------------------------
+# persistence + diffing
+# ---------------------------------------------------------------------------
+def save_goldens(contracts: Dict[str, Any],
+                 path: Optional[str] = None) -> str:
+    path = path or golden_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(contracts, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_goldens(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or golden_path()
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def diff_contracts(golden: Dict[str, Any], measured: Dict[str, Any],
+                   _prefix: str = "") -> List[str]:
+    """Readable field-level delta, ``golden -> measured``. Empty list ==
+    no drift."""
+    lines: List[str] = []
+    keys = sorted(set(golden) | set(measured))
+    for k in keys:
+        if k == "accounting_findings":
+            continue
+        path = f"{_prefix}{k}"
+        if k not in golden:
+            lines.append(f"{path}: (absent in golden) -> "
+                         f"{measured[k]!r} (regenerate goldens?)")
+        elif k not in measured:
+            lines.append(f"{path}: {golden[k]!r} -> (absent in measured)")
+        elif isinstance(golden[k], dict) and isinstance(measured[k], dict):
+            lines += diff_contracts(golden[k], measured[k],
+                                    _prefix=path + ".")
+        elif golden[k] != measured[k]:
+            lines.append(f"{path}: {golden[k]!r} -> {measured[k]!r}")
+    return lines
+
+
+def check(path: Optional[str] = None,
+          measured: Optional[Dict[str, Any]] = None
+          ) -> Tuple[bool, List[str]]:
+    """The CI gate: capture fresh contracts and diff against the
+    committed goldens. Returns ``(ok, delta_lines)``."""
+    golden = load_goldens(path)
+    if measured is None:
+        measured = capture_contracts()
+    delta = diff_contracts(golden, measured)
+    return (not delta, delta)
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m analytics_zoo_tpu.analysis.golden --update | --check
+# ---------------------------------------------------------------------------
+def _init_mesh():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from analytics_zoo_tpu import init_orca_context
+    init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Golden program-contract snapshots for the bench "
+                    "train steps")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate tests/goldens/ from the current tree")
+    ap.add_argument("--check", action="store_true",
+                    help="diff current tree vs committed goldens; exit 1 "
+                         "on drift")
+    ap.add_argument("--path", default=None, help="golden file override")
+    args = ap.parse_args(argv)
+    _init_mesh()
+    if args.update:
+        contracts = capture_contracts()
+        path = save_goldens(contracts, args.path)
+        print(f"wrote {path}")
+        for name, _, _ in _LEGS:
+            entry = contracts[name]
+            print(f"  {name}: collectives={entry['collectives']} "
+                  f"rs_wire_bytes={entry['rs_wire_bytes']} "
+                  f"donation={entry['donation']}")
+        return 0
+    ok, delta = check(args.path)
+    if ok:
+        print("golden program contracts: OK "
+              "(no drift vs tests/goldens/)")
+        return 0
+    print("golden program contracts DRIFTED (golden -> measured):")
+    for line in delta:
+        print(f"  {line}")
+    print("if this change is intentional, regenerate with: "
+          "python -m analytics_zoo_tpu.analysis.golden --update")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,437 @@
+"""Runtime race detector: traced locks, a lock-order graph, and watched
+shared objects.
+
+STATUS.md row 37 ("race detection") was N/A since the seed — this closes
+it. The stack has 21 lock-using modules (ckpt writer, infeed pump,
+watchdog, serving engine, trial runtime, ...); nothing ever checked that
+they acquire those locks in a consistent order, or that the attributes
+they share across threads are actually written under the lock that
+supposedly guards them.
+
+Approach (lockdep-style, in-process, zero code changes to the planes):
+
+* While enabled, ``threading.Lock``/``threading.RLock`` construction is
+  routed through traced wrappers. Every lock is tagged with its creation
+  *site* (``module:lineno``) — the class of the lock, in lockdep terms.
+* Each thread keeps a held-lock stack. Acquiring ``B`` while holding
+  ``A`` records the edge ``A -> B`` in the site-level lock-order graph;
+  a cycle in that graph (``A -> B`` somewhere, ``B -> A`` elsewhere) is
+  a **lock-order inversion** — a deadlock that needs only the right
+  interleaving, reported without ever deadlocking.
+* :meth:`RaceDetector.watch` registers a shared object with the lock
+  that guards it. Attribute writes are then checked: an attribute
+  written from >= 2 distinct threads where at least one write did not
+  hold the registered lock is an **unsynchronized write**.
+
+Enable per-test via ``with get_detector().trace(): ...``, or for a whole
+tier-1 run via ``ZOO_RACE_DETECT=1`` (tests/conftest.py installs it
+session-wide and prints the report at exit). Instrumentation only covers
+locks created while enabled — enable first, then build the objects under
+test.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+import weakref
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["RaceDetector", "TracedLock", "get_detector"]
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# the real factories, captured at import — a detector's traced locks must
+# wrap THESE, never whatever ``threading.Lock`` currently points at:
+# nesting a private detector inside the session-wide one (the seeded
+# tests under ZOO_RACE_DETECT=1) would otherwise wrap TracedLocks in
+# TracedLocks and double-report every acquisition to both detectors
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def _creation_site() -> str:
+    """``module:lineno`` of the frame that constructed the lock — the
+    lock's *class* for ordering purposes (skips this module and
+    threading.py, so e.g. a Condition's internal RLock is attributed to
+    whoever built the Condition)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if (not fn.startswith(_THIS_DIR)
+                and os.path.basename(fn) != "threading.py"):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class TracedLock:
+    """Wrapper around a real lock that reports acquire/release to the
+    detector. Implements the full lock protocol ``threading.Condition``
+    relies on (``_is_owned``/``_release_save``/``_acquire_restore``), so
+    patched-in locks work anywhere the originals did."""
+
+    def __init__(self, detector: "RaceDetector", inner, site: str,
+                 reentrant: bool):
+        self._detector = detector
+        self._inner = inner
+        self.site = site
+        self._reentrant = reentrant
+        self.uid = detector._register_lock(self)
+
+    # -- core protocol -------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = (self._inner.acquire(blocking, timeout) if timeout != -1
+               else self._inner.acquire(blocking))
+        if got:
+            self._detector._on_acquire(self)
+        return got
+
+    def release(self):
+        self._detector._on_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        try:
+            return self._inner.locked()
+        except AttributeError:      # RLock pre-3.12 has no .locked()
+            if self._inner.acquire(False):
+                self._inner.release()
+                return False
+            return True
+
+    # -- Condition plumbing --------------------------------------------------
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return self._detector.held_by_current_thread(self)
+
+    def _release_save(self):
+        # Condition.wait: fully release (all recursion levels) and hand
+        # back restore state — drop every held-stack entry for this lock
+        self._detector._on_release(self, all_levels=True)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._detector._on_acquire(self)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<TracedLock {self.site} uid={self.uid}>"
+
+
+class _Watch:
+    __slots__ = ("ref", "lock", "name", "attrs", "writes", "unheld")
+
+    def __init__(self, obj, lock, name, attrs):
+        self.ref = weakref.ref(obj)
+        self.lock = lock
+        self.name = name
+        self.attrs = set(attrs) if attrs is not None else None
+        # attr -> set of thread idents that wrote it
+        self.writes: Dict[str, Set[int]] = {}
+        # attr -> count of writes made without the registered lock held
+        self.unheld: Dict[str, int] = {}
+
+
+class RaceDetector:
+    """See module docstring. One instance is process-wide
+    (:func:`get_detector`); tests may build private ones."""
+
+    def __init__(self):
+        # raw _thread locks: the detector's own bookkeeping must not ride
+        # the (possibly patched) threading factories it instruments
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        # tid -> that thread's held stack (the same lists the TLS holds),
+        # so a cross-thread release can find and clear the acquirer's entry
+        self._stacks: Dict[int, List[Tuple[int, str]]] = {}
+        self._enabled = False
+        self._orig_lock: Optional[Callable] = None
+        self._orig_rlock: Optional[Callable] = None
+        self._locks: Dict[int, str] = {}            # uid -> site
+        self._next_uid = 0
+        self._acquisitions = 0
+        # (site_a, site_b) -> count: a held while b acquired
+        self._edges: Dict[Tuple[str, str], int] = {}
+        self._watched: Dict[int, _Watch] = {}
+        self._patched_classes: Dict[type, Callable] = {}
+
+    # -- enable / disable ----------------------------------------------------
+    def enable(self):
+        """Patch the ``threading.Lock``/``RLock`` factories; locks created
+        from now on are traced."""
+        with self._mu:
+            if self._enabled:
+                return
+            # restore targets (may themselves be another detector's
+            # factories when nested); inner locks always come from the
+            # REAL factories so each lock reports to exactly one detector
+            self._orig_lock = threading.Lock
+            self._orig_rlock = threading.RLock
+            detector = self
+
+            def _lock_factory():
+                return TracedLock(detector, _REAL_LOCK(),
+                                  _creation_site(), reentrant=False)
+
+            def _rlock_factory():
+                return TracedLock(detector, _REAL_RLOCK(),
+                                  _creation_site(), reentrant=True)
+
+            threading.Lock = _lock_factory
+            threading.RLock = _rlock_factory
+            self._enabled = True
+
+    def disable(self):
+        """Restore the real factories. Collected evidence survives for
+        :meth:`report`; already-created traced locks keep working (their
+        bookkeeping just stops growing the graph once released)."""
+        with self._mu:
+            if not self._enabled:
+                return
+            threading.Lock = self._orig_lock
+            threading.RLock = self._orig_rlock
+            self._enabled = False
+
+    @contextmanager
+    def trace(self):
+        self.enable()
+        try:
+            yield self
+        finally:
+            self.disable()
+
+    # -- lock bookkeeping ----------------------------------------------------
+    def _register_lock(self, lock: TracedLock) -> int:
+        with self._mu:
+            self._next_uid += 1
+            self._locks[self._next_uid] = lock.site
+            return self._next_uid
+
+    def _held(self) -> List[Tuple[int, str]]:
+        stack = getattr(self._tls, "held", None)
+        if stack is None:
+            stack = self._tls.held = []
+            with self._mu:
+                self._stacks[threading.get_ident()] = stack
+        return stack
+
+    def _on_acquire(self, lock: TracedLock):
+        stack = self._held()
+        held_uids = [uid for uid, _ in stack]
+        if lock.uid not in held_uids:       # reentrant re-acquire: no edge
+            new_edges = []
+            for uid, site in stack:
+                if uid != lock.uid and site != lock.site:
+                    new_edges.append((site, lock.site))
+            if new_edges:
+                with self._mu:
+                    for e in new_edges:
+                        self._edges[e] = self._edges.get(e, 0) + 1
+        stack.append((lock.uid, lock.site))
+        with self._mu:
+            self._acquisitions += 1
+
+    def _on_release(self, lock: TracedLock, all_levels: bool = False):
+        stack = self._held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == lock.uid:
+                del stack[i]
+                if not all_levels:
+                    return
+        if all_levels or lock._reentrant:
+            return
+        # a plain Lock may legally be released by a thread that never
+        # acquired it; clear the acquirer's stale entry so it doesn't
+        # generate bogus order edges for everything that thread takes
+        # next. The owner may be mutating its own stack concurrently
+        # (appends/deletes ride the GIL, not _mu), so scan defensively —
+        # a shifted index must degrade to a missed cleanup, never crash
+        # the instrumented application's release()
+        my_stack = stack
+        with self._mu:
+            stacks = list(self._stacks.values())
+            for other in stacks:
+                if other is my_stack:
+                    continue
+                try:
+                    for i in range(len(other) - 1, -1, -1):
+                        if other[i][0] == lock.uid:
+                            del other[i]
+                            return
+                except IndexError:
+                    continue
+
+    def held_by_current_thread(self, lock) -> bool:
+        uid = getattr(lock, "uid", None)
+        if uid is None:
+            return False
+        return any(u == uid for u, _ in self._held())
+
+    # -- watched shared objects ----------------------------------------------
+    def watch(self, obj: Any, lock: Any, name: Optional[str] = None,
+              attrs: Optional[Sequence[str]] = None):
+        """Register ``obj`` as shared state guarded by ``lock``. Attribute
+        writes (all of them, or just ``attrs``) are recorded with the
+        writing thread and whether the registered lock was held.
+
+        ``lock`` may be a :class:`TracedLock`, anything with
+        ``_is_owned`` (an RLock), or a zero-arg callable returning
+        whether the current thread holds it."""
+        cls = type(obj)
+        with self._mu:
+            self._watched[id(obj)] = _Watch(obj, lock, name
+                                            or cls.__name__, attrs)
+            if cls not in self._patched_classes:
+                orig = cls.__setattr__
+                detector = self
+
+                def _traced_setattr(inst, attr, value, _orig=orig):
+                    detector._on_setattr(inst, attr)
+                    _orig(inst, attr, value)
+
+                cls.__setattr__ = _traced_setattr
+                self._patched_classes[cls] = orig
+
+    def _lock_is_held(self, lock) -> bool:
+        if callable(lock) and not hasattr(lock, "acquire"):
+            try:
+                return bool(lock())
+            except Exception:  # noqa: BLE001 — a broken probe means unknown
+                return False
+        if isinstance(lock, TracedLock):
+            return self.held_by_current_thread(lock)
+        if hasattr(lock, "_is_owned"):
+            try:
+                return bool(lock._is_owned())
+            except Exception:  # noqa: BLE001
+                return False
+        return False
+
+    def _on_setattr(self, inst, attr: str):
+        watch = self._watched.get(id(inst))
+        if watch is None or watch.ref() is not inst:
+            return
+        if watch.attrs is not None and attr not in watch.attrs:
+            return
+        held = self._lock_is_held(watch.lock)
+        tid = threading.get_ident()
+        with self._mu:
+            watch.writes.setdefault(attr, set()).add(tid)
+            if not held:
+                watch.unheld[attr] = watch.unheld.get(attr, 0) + 1
+
+    def unwatch_all(self):
+        """Restore patched ``__setattr__`` s and drop the watch registry
+        (tests call this so class patches don't leak across tests)."""
+        with self._mu:
+            for cls, orig in self._patched_classes.items():
+                cls.__setattr__ = orig
+            self._patched_classes.clear()
+            self._watched.clear()
+
+    # -- analysis ------------------------------------------------------------
+    def inversions(self) -> List[List[str]]:
+        """Cycles in the site-level lock-order graph. A 2-cycle
+        ``[A, B]`` means some thread acquired B while holding A and some
+        thread acquired A while holding B — deadlock needs only the right
+        interleaving."""
+        with self._mu:
+            edges = dict(self._edges)
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        cycles: List[List[str]] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def _dfs(start: str, node: str, path: List[str],
+                 on_path: Set[str]):
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) >= 2:
+                    key = tuple(sorted(path))
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(list(path))
+                elif nxt not in on_path and nxt > start:
+                    # only walk nodes ordered after start so each cycle
+                    # is discovered from its smallest site exactly once
+                    on_path.add(nxt)
+                    _dfs(start, nxt, path + [nxt], on_path)
+                    on_path.discard(nxt)
+
+        for start in sorted(adj):
+            _dfs(start, start, [start], {start})
+        return cycles
+
+    def unsynchronized(self) -> List[Dict[str, Any]]:
+        """Watched attributes written from >= 2 threads with at least one
+        write not holding the registered lock."""
+        out = []
+        with self._mu:
+            watches = list(self._watched.values())
+        for w in watches:
+            for attr, tids in w.writes.items():
+                unheld = w.unheld.get(attr, 0)
+                if len(tids) >= 2 and unheld > 0:
+                    out.append({"object": w.name, "attr": attr,
+                                "threads": len(tids),
+                                "unheld_writes": unheld})
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        with self._mu:
+            n_locks = len(self._locks)
+            n_edges = len(self._edges)
+            acq = self._acquisitions
+        inv = self.inversions()
+        unsync = self.unsynchronized()
+        return {"enabled": self._enabled, "locks": n_locks,
+                "acquisitions": acq, "order_edges": n_edges,
+                "inversions": inv, "unsynchronized": unsync,
+                "clean": not inv and not unsync}
+
+    def reset(self):
+        # _next_uid is deliberately NOT reset: live TracedLocks keep
+        # their uids, and reissuing them would alias new locks onto old
+        # ones in every per-thread held stack
+        with self._mu:
+            self._locks.clear()
+            self._edges.clear()
+            self._acquisitions = 0
+        self.unwatch_all()
+
+
+_global_detector: Optional[RaceDetector] = None
+_global_mu = _thread.allocate_lock()
+
+
+def get_detector() -> RaceDetector:
+    """The process-wide detector (created lazily; disabled until someone
+    enables it — ``ZOO_RACE_DETECT=1`` does so for a whole test run via
+    tests/conftest.py)."""
+    global _global_detector
+    with _global_mu:
+        if _global_detector is None:
+            _global_detector = RaceDetector()
+        return _global_detector

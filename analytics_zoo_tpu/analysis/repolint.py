@@ -1,0 +1,283 @@
+"""AST-based repo lint behind the ``zoo-lint`` CLI (a CI gate).
+
+Four rules, each encoding a defect class this codebase has actually
+shipped:
+
+``env-knob``         a ``ZOO_*`` environment name read (or written)
+                     through ``os.environ``/``os.getenv``/``knobs.get``
+                     that is not registered in
+                     :mod:`analytics_zoo_tpu.common.knobs` — a typo'd
+                     knob fails silently back to its default forever.
+``silent-except``    ``except``/``except Exception``/``except
+                     BaseException`` whose entire body is ``pass`` — the
+                     five PR-9 satellite fixes were exactly these.
+``thread-attrs``     ``threading.Thread(...)`` without ``daemon=`` or
+                     without ``name=`` — an unnamed non-daemon thread is
+                     invisible in stack dumps and blocks interpreter
+                     exit.
+``mutable-default``  a list/dict/set literal (or constructor call) as a
+                     default argument value.
+
+Scope: the ``analytics_zoo_tpu`` package, ``bench.py`` and ``scripts/``;
+``--all`` adds ``tests/``. Exit code 1 when any finding survives, so CI
+can gate on it directly::
+
+    zoo-lint             # console entry (pyproject.toml)
+    python -m analytics_zoo_tpu.analysis.repolint --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+from ..common import knobs
+
+__all__ = ["RepoFinding", "lint_file", "lint_paths", "main", "repo_roots"]
+
+RULES = ("env-knob", "silent-except", "thread-attrs", "mutable-default")
+
+
+@dataclass
+class RepoFinding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def repo_roots(include_tests: bool = False) -> List[str]:
+    root = repo_root()
+    paths = [os.path.join(root, "analytics_zoo_tpu"),
+             os.path.join(root, "bench.py"),
+             os.path.join(root, "scripts")]
+    if include_tests:
+        paths.append(os.path.join(root, "tests"))
+    return [p for p in paths if os.path.exists(p)]
+
+
+def _iter_py(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", "build")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+# ---------------------------------------------------------------------------
+# the visitor
+# ---------------------------------------------------------------------------
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """``os.environ`` or a bare ``environ`` (from os import environ)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _literal_zoo_name(node: ast.expr) -> Optional[str]:
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.startswith("ZOO_")):
+        return node.value
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[RepoFinding] = []
+
+    def _add(self, node: ast.AST, rule: str, message: str):
+        self.findings.append(RepoFinding(self.path, node.lineno, rule,
+                                         message))
+
+    # -- env-knob ------------------------------------------------------------
+    def _check_zoo_name(self, node: ast.AST, name: Optional[str]):
+        if name is not None and not knobs.is_registered(name):
+            self._add(node, "env-knob",
+                      f"{name} is not registered in common/knobs.py — "
+                      f"register it (name, type, default, doc) or fix the "
+                      f"typo")
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        # os.environ.get / environ.get / os.environ.setdefault / .pop
+        if (isinstance(func, ast.Attribute)
+                and func.attr in ("get", "setdefault", "pop")
+                and _is_environ(func.value) and node.args):
+            self._check_zoo_name(node, _literal_zoo_name(node.args[0]))
+        # os.getenv
+        elif (isinstance(func, ast.Attribute) and func.attr == "getenv"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "os" and node.args):
+            self._check_zoo_name(node, _literal_zoo_name(node.args[0]))
+        # knobs.get("ZOO_...") — same registry, checked statically
+        elif (isinstance(func, ast.Attribute) and func.attr == "get"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "knobs" and node.args):
+            self._check_zoo_name(node, _literal_zoo_name(node.args[0]))
+        # threading.Thread(...) / Thread(...)
+        self._check_thread(node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if _is_environ(node.value):
+            self._check_zoo_name(node, _literal_zoo_name(node.slice))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        # "ZOO_X" in os.environ
+        if (len(node.ops) == 1 and isinstance(node.ops[0], (ast.In,
+                                                            ast.NotIn))
+                and _is_environ(node.comparators[0])):
+            self._check_zoo_name(node, _literal_zoo_name(node.left))
+        self.generic_visit(node)
+
+    # -- silent-except -------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        broad = node.type is None
+        if isinstance(node.type, ast.Name) and node.type.id in _BROAD:
+            broad = True
+        if isinstance(node.type, ast.Tuple):
+            broad = any(isinstance(e, ast.Name) and e.id in _BROAD
+                        for e in node.type.elts)
+        body_is_pass = all(
+            isinstance(stmt, ast.Pass)
+            or (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis)
+            for stmt in node.body)
+        if broad and body_is_pass:
+            caught = ("bare except" if node.type is None
+                      else ast.unparse(node.type))
+            self._add(node, "silent-except",
+                      f"{caught} swallowed with `pass` — narrow the "
+                      f"exception type or log it")
+        self.generic_visit(node)
+
+    # -- thread-attrs --------------------------------------------------------
+    def _check_thread(self, node: ast.Call):
+        func = node.func
+        is_thread = (
+            (isinstance(func, ast.Attribute) and func.attr == "Thread"
+             and isinstance(func.value, ast.Name)
+             and func.value.id == "threading")
+            or (isinstance(func, ast.Name) and func.id == "Thread"))
+        if not is_thread:
+            return
+        kwargs = {kw.arg for kw in node.keywords}
+        missing = [a for a in ("daemon", "name") if a not in kwargs]
+        if missing:
+            self._add(node, "thread-attrs",
+                      f"threading.Thread without {'/'.join(missing)} — "
+                      f"unnamed or non-daemon worker threads are "
+                      f"undebuggable and can block exit")
+
+    # -- mutable-default -----------------------------------------------------
+    def _check_defaults(self, node):
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self._add(default, "mutable-default",
+                          f"mutable default argument "
+                          f"`{ast.unparse(default)}` is shared across "
+                          f"calls — use None and build inside")
+            elif (isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set")):
+                self._add(default, "mutable-default",
+                          f"mutable default argument "
+                          f"`{ast.unparse(default)}` is shared across "
+                          f"calls — use None and build inside")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+
+def lint_file(path: str, rules: Optional[Sequence[str]] = None
+              ) -> List[RepoFinding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [RepoFinding(path, e.lineno or 0, "syntax",
+                            f"file does not parse: {e.msg}")]
+    visitor = _Visitor(path)
+    visitor.visit(tree)
+    findings = visitor.findings
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    return findings
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Optional[Sequence[str]] = None) -> List[RepoFinding]:
+    findings: List[RepoFinding] = []
+    for path in _iter_py(paths):
+        findings.extend(lint_file(path, rules=rules))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zoo-lint",
+        description="AST repo lint: unregistered ZOO_* env reads, silent "
+                    "except-pass, threads without daemon/name, mutable "
+                    "default args")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package, "
+                         "bench.py and scripts/)")
+    ap.add_argument("--all", action="store_true",
+                    help="also lint tests/")
+    ap.add_argument("--rule", action="append", choices=RULES,
+                    help="run only these rules (repeatable)")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or repo_roots(include_tests=args.all)
+    files = list(_iter_py(paths))
+    findings = lint_paths(files, rules=args.rule)
+    root = repo_root()
+    for f in findings:
+        if f.path.startswith(root + os.sep):
+            f.path = os.path.relpath(f.path, root)
+    if args.json:
+        print(json.dumps({"findings": [vars(f) for f in findings],
+                          "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(f"zoo-lint: {len(findings)} finding(s) in "
+              f"{len(files)} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,5 +79,7 @@ class EventLog:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except (OSError, ValueError, AttributeError):
+            # interpreter-shutdown teardown: the file handle (or the lock
+            # attribute itself) may already be torn down
             pass

@@ -1,0 +1,190 @@
+"""Single registry for every ``ZOO_*`` environment knob.
+
+Each plane used to document its own env vars in its own docstring; nothing
+guaranteed the name in the docs matched the name the code read, and a typo'd
+``os.environ.get("ZOO_H2D_LANE")`` failed silently back to the default. Every
+knob now has exactly one row here — name, type, default, one-line doc — and
+the repo lint (``analysis/repolint.py``) rejects ``os.environ`` reads of
+``ZOO_*`` names that are not registered, so a new knob cannot ship without a
+registry row and a doc line.
+
+``knobs.get(name)`` is the typed accessor (env wins, else the registered
+default). Reading a registered knob directly through ``os.environ`` stays
+legal — many call sites need custom unset-vs-empty semantics — the contract
+is only that the NAME is registered. ``python -m analytics_zoo_tpu.common.knobs``
+prints the registry as a markdown table (pasted into
+``docs/performance_notes.md``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+__all__ = ["Knob", "REGISTRY", "get", "is_registered", "markdown_table"]
+
+_FALSY = ("", "0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str          # "int" | "float" | "bool" | "str"
+    default: Any
+    doc: str
+    plane: str = ""    # which subsystem owns it (docs grouping)
+
+
+def _k(name: str, type_: str, default: Any, plane: str, doc: str) -> Knob:
+    return Knob(name=name, type=type_, default=default, doc=doc, plane=plane)
+
+
+_KNOBS = [
+    # --- infeed / transfer plane -------------------------------------------
+    _k("ZOO_INFEED_WORKERS", "int", None, "infeed",
+       "Assembly worker threads feeding the infeed pump (default: auto from "
+       "CPU count)."),
+    _k("ZOO_INFEED_BUDGET_MB", "int", 256, "infeed",
+       "Host-memory budget bounding the pump's adaptive prefetch depth."),
+    _k("ZOO_H2D_LANES", "int", 2, "transfer",
+       "Parallel host-to-device transfer lanes behind the in-order FIFO "
+       "window (cap 8)."),
+    _k("ZOO_HOST_STAGING", "bool", None, "transfer",
+       "Force the reusable host staging-buffer pool on/off (default: auto — "
+       "on for non-CPU backends)."),
+    # --- compile plane ------------------------------------------------------
+    _k("ZOO_COMPILE_CACHE", "str", None, "compile",
+       "Directory for the persistent executable cache (also enables JAX's "
+       "own compilation cache under <dir>/xla)."),
+    _k("ZOO_COMPILE_CACHE_DISABLE", "bool", False, "compile",
+       "Disable the shared executable cache entirely (every consumer "
+       "degrades to private jax.jit)."),
+    # --- comms plane --------------------------------------------------------
+    _k("ZOO_COMMS_PLANE", "bool", None, "comms",
+       "Enter the comms plane with the flat per-leaf-psum reference wire "
+       "(buckets/sharding off)."),
+    _k("ZOO_GRAD_BUCKET_MB", "float", 0.0, "comms",
+       "Target gradient bucket size for the reduce-scatter wire; 0 keeps "
+       "the flat per-leaf wire."),
+    _k("ZOO_SHARDED_UPDATE", "bool", False, "comms",
+       "ZeRO-1: shard the optimizer update over the dp axis (each replica "
+       "updates padded/N elements, then all-gathers params)."),
+    _k("ZOO_ALLREDUCE_DTYPE", "str", "f32", "comms",
+       "Gradient wire dtype: f32 | bf16 (real bf16 collective) | int8 "
+       "(block-scaled, simulated wire)."),
+    _k("ZOO_ALLREDUCE_BLOCK", "int", 256, "comms",
+       "Elements per int8 quantization scale block."),
+    _k("ZOO_EMBED_GRAD_MODE", "str", "auto", "comms",
+       "Embedding gradient exchange: auto | dense | sparse."),
+    # --- checkpoint plane ---------------------------------------------------
+    _k("ZOO_CKPT_IO_RETRIES", "int", 2, "ckpt",
+       "Retries for a failed checkpoint blob write before the writer "
+       "records the error (exp backoff)."),
+    # --- resilience plane ---------------------------------------------------
+    _k("ZOO_FAULTS", "str", None, "resilience",
+       "Fault-injection spec armed at import, e.g. "
+       "'engine.dispatch:prob=0.01,kind=crash'."),
+    _k("ZOO_FAULT_SEED", "int", 0, "resilience",
+       "Seed for the per-site fault RNG streams (a fixed seed replays the "
+       "exact fire pattern)."),
+    _k("ZOO_DISPATCH_TIMEOUT_S", "float", None, "resilience",
+       "Watchdog bound on one device dispatch / H2D placement; unset "
+       "disables hang detection."),
+    _k("ZOO_SUPERVISOR_REINIT_BACKEND", "bool", False, "resilience",
+       "On classified device loss, additionally clear JAX backends before "
+       "the supervisor rebuilds."),
+    _k("ZOO_BROKER_RECONNECT_RETRIES", "int", 4, "serving",
+       "Redis broker reconnect attempts before giving up."),
+    _k("ZOO_BROKER_RECONNECT_BACKOFF_S", "float", 0.2, "serving",
+       "Base backoff between broker reconnect attempts."),
+    # --- multihost ----------------------------------------------------------
+    _k("ZOO_COORDINATOR", "str", None, "multihost",
+       "host:port of the jax.distributed coordinator for multi-process "
+       "runs."),
+    _k("ZOO_NUM_PROCS", "int", None, "multihost",
+       "Total process count for jax.distributed initialization."),
+    _k("ZOO_PROC_ID", "int", None, "multihost",
+       "This process's index for jax.distributed initialization."),
+    _k("ZOO_COORDINATOR_PORT", "int", 8476, "multihost",
+       "Coordinator port scripts/launch_multihost.sh binds when deriving "
+       "ZOO_COORDINATOR from the host list."),
+    # --- bench --------------------------------------------------------------
+    _k("ZOO_BENCH_FORCED_CPU", "bool", False, "bench",
+       "Internal marker set by bench.py's guarded re-exec after TPU init "
+       "failure (prevents a retry loop)."),
+    # --- analysis plane -----------------------------------------------------
+    _k("ZOO_HLO_LINT", "str", "warn", "analysis",
+       "StableHLO linter on every compile-plane lowering: warn (log + "
+       "report) | strict (raise on error-severity) | 0 (off)."),
+    _k("ZOO_LINT_DONATION_MB", "float", 64.0, "analysis",
+       "hlo-lint threshold: an undonated input buffer at least this large "
+       "in a donating program is flagged."),
+    _k("ZOO_RACE_DETECT", "bool", False, "analysis",
+       "Enable the runtime race detector (traced locks + lock-order graph) "
+       "for the whole test session."),
+]
+
+REGISTRY: Dict[str, Knob] = {k.name: k for k in _KNOBS}
+
+_UNSET = object()
+
+
+def is_registered(name: str) -> bool:
+    return name in REGISTRY
+
+
+def _coerce(knob: Knob, raw: str):
+    if knob.type == "bool":
+        return raw.strip().lower() not in _FALSY
+    if knob.type == "int":
+        return int(raw)
+    if knob.type == "float":
+        return float(raw)
+    return raw
+
+
+def get(name: str, default: Any = _UNSET) -> Any:
+    """Typed read of a registered knob: the environment wins, else
+    ``default`` (when given), else the registered default. Unset or
+    empty-string env values mean "not set". Raises ``KeyError`` for an
+    unregistered name — the point of the registry is that those don't
+    exist."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"{name} is not a registered ZOO_* knob; add it to "
+            f"analytics_zoo_tpu/common/knobs.py (the repo lint enforces "
+            f"this)")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return knob.default if default is _UNSET else default
+    try:
+        return _coerce(knob, raw)
+    except ValueError as e:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {knob.type}: {e}") from e
+
+
+def markdown_table(plane: Optional[str] = None) -> str:
+    """The registry as a markdown table (docs/performance_notes.md pastes
+    this; regenerate with ``python -m analytics_zoo_tpu.common.knobs``)."""
+    rows = ["| knob | type | default | plane | what it does |",
+            "|---|---|---|---|---|"]
+    for k in _KNOBS:
+        if plane is not None and k.plane != plane:
+            continue
+        default = "auto/unset" if k.default is None else repr(k.default)
+        doc = k.doc.replace("|", "\\|")     # literal pipes break the table
+        rows.append(f"| `{k.name}` | {k.type} | {default} | {k.plane} "
+                    f"| {doc} |")
+    return "\n".join(rows)
+
+
+def main() -> int:
+    print(markdown_table())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,7 +122,7 @@ class CachedFunction:
         # layout): identity the lowered text alone might not capture
         self._extra_key = extra_key
         self._local: Dict = {}       # sig -> executable (per-callsite fast path)
-        self._keyinfo: Dict = {}     # sig -> (key, lowered) awaiting compile
+        self._keyinfo: Dict = {}     # sig -> (key, lowered, text) awaiting compile
         self._plain = None
         self._lock = threading.Lock()
 
@@ -153,15 +153,31 @@ class CachedFunction:
             return info[0]
         try:
             lowered = self._fresh_jit().lower(*args)
+            text = lowered.as_text()
             key = self._cache.key_of(lowered, self._donate, args,
-                                     extra_key=self._extra_key)
+                                     extra_key=self._extra_key, text=text)
         except Exception as e:  # noqa: BLE001 — untraceable fn
             logger.debug("cache_key lowering failed (%s: %s)",
                          type(e).__name__, e)
             return None
         with self._lock:
-            self._keyinfo[sig] = (key, lowered)
+            self._keyinfo[sig] = (key, lowered, text)
         return key
+
+    def lowered_text(self, *args) -> Optional[str]:
+        """Rendered StableHLO of the lowering for ``args``, reusing the
+        lowering (and render) that :meth:`cache_key` produced for the
+        same signature — callers that want both the key and the text
+        (the golden program-contract capture) pay one lower+render."""
+        sig = self._signature(args)
+        with self._lock:
+            info = self._keyinfo.get(sig)
+        if info is None:
+            if self.cache_key(*args) is None:
+                return None
+            with self._lock:
+                info = self._keyinfo.get(sig)
+        return info[2] if info is not None else None
 
     def _ensure_executable(self, args):
         sig = self._signature(args)
@@ -264,11 +280,16 @@ class ExecutableCache:
 
     # --- keying -------------------------------------------------------------
     def key_of(self, lowered, donate_argnums, args,
-               extra_key: Optional[str] = None) -> str:
+               extra_key: Optional[str] = None,
+               text: Optional[str] = None) -> str:
         import jax
         import jaxlib
         h = hashlib.sha256()
-        h.update(lowered.as_text().encode())
+        # rendering StableHLO text is the expensive part of keying; callers
+        # that already hold the rendered module pass it in (the lint hook
+        # reuses the same text, so one render covers both)
+        h.update((text if text is not None
+                  else lowered.as_text()).encode())
         h.update(repr((jax.__version__, jaxlib.__version__,
                        jax.default_backend(), tuple(donate_argnums),
                        _arg_devices(jax.tree_util.tree_leaves(args)),
@@ -292,18 +313,21 @@ class ExecutableCache:
         """Resolve the executable for one call signature: shared memory
         store, then disk, then a real (timed, counted) AOT compile."""
         if keyinfo is not None:
-            key, lowered = keyinfo
+            key, lowered, text = keyinfo
         else:
             try:
                 lowered = cf._fresh_jit().lower(*args)
+                text = lowered.as_text()
                 key = self.key_of(lowered, cf._donate, args,
-                                  extra_key=cf._extra_key)
+                                  extra_key=cf._extra_key, text=text)
             except Exception as e:  # noqa: BLE001 — untraceable: plain jit
                 logger.warning(
                     "compile plane cannot lower %r (%s: %s); using plain "
                     "jit", cf.label or cf._fn, type(e).__name__, e)
                 self.stats.record_fallback(cf.label)
                 return cf._plain_jit()
+
+        self._lint_lowering(cf, key, lowered, args, text=text)
 
         while True:
             with self._lock:
@@ -350,6 +374,28 @@ class ExecutableCache:
                 ev = self._inflight.pop(key, None)
             if ev is not None:
                 ev.set()
+
+    def _lint_lowering(self, cf: CachedFunction, key: str, lowered, args,
+                       text: Optional[str] = None):
+        """Analysis-plane hook: every lowering the cache resolves is linted
+        before it compiles (``ZOO_HLO_LINT``: warn | strict | 0). Dedup is
+        on the cache key, so re-lowerings and disk hits lint once per
+        process. Only strict mode's :class:`HloLintError` may escape — any
+        other failure inside the linter must not break a compile."""
+        try:
+            from ..analysis import hlo_lint
+        except ImportError:
+            return
+        try:
+            hlo_lint.on_lowering(cf.label, lowered,
+                                 donate_argnums=cf._donate, args=args,
+                                 extra_key=cf._extra_key, key=key,
+                                 text=text)
+        except hlo_lint.HloLintError:
+            raise
+        except Exception as e:  # noqa: BLE001 — lint must not break compiles
+            logger.debug("hlo-lint hook failed for %r (%s: %s)",
+                         cf.label, type(e).__name__, e)
 
     # --- disk persistence ---------------------------------------------------
     def _exe_path(self, key: str) -> Optional[str]:
@@ -425,8 +471,11 @@ class ExecutableCache:
                 with self._lock:
                     self._aux[(namespace, key)] = value
                 return value
-            except Exception:  # noqa: BLE001 — corrupt aux file
-                pass
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # corrupt/truncated aux file: treat as a miss (the probe
+                # that produced it simply reruns)
+                logger.debug("aux cache entry %s unusable (%s: %s)", path,
+                             type(e).__name__, e)
         return default
 
     def put_aux(self, namespace: str, key: str, value):
@@ -490,8 +539,9 @@ def configure_compile_cache(cache_dir: str) -> Optional[ExecutableCache]:
                              0)):
             try:
                 jax.config.update(knob, value)
-            except Exception:  # noqa: BLE001 — knob absent on this jax
-                pass
+            except Exception as e:  # noqa: BLE001 — knob absent on this jax
+                logger.debug("jax config knob %s not set (%s: %s)", knob,
+                             type(e).__name__, e)
     except Exception as e:  # noqa: BLE001 — persistent cache is best-effort
         logger.debug("jax_compilation_cache_dir not enabled (%s: %s)",
                      type(e).__name__, e)

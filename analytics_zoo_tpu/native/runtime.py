@@ -219,7 +219,9 @@ class Arena:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except (OSError, RuntimeError, AttributeError):
+            # interpreter-shutdown teardown: the ctypes lib or our own
+            # attributes may already be gone; nothing to log to either
             pass
 
 

@@ -681,12 +681,25 @@ class TrainEngine:
         # (donating an empty pytree arg is pointless noise)
         return (0, 2, 3) if self.comms_resid is not None else (0, 2)
 
+    def _declare_comms_accounting(self):
+        """Hand the comms plane's declared per-step accounting to the
+        analysis plane under the same fingerprint the train executables
+        are salted with — the HLO linter then cross-checks every lowered
+        train program against it (measured launches/bytes == declared, or
+        a ``comms-accounting`` lint finding)."""
+        try:
+            from ...analysis.hlo_lint import declare_comms
+        except ImportError:
+            return
+        declare_comms(self._comms_key(), self.comms.summary())
+
     def ensure_jit_train(self):
         """Build (or return) the jitted single-step executable — the one
         place its jit options live, shared by train_batch and the
         estimator's fuse probe."""
         if self._jit_train is None:
             if self.comms is not None:
+                self._declare_comms_accounting()
                 self._jit_train = self._wrap(
                     "train", self._comms_train_step,
                     donate_argnums=self._comms_donate(),
@@ -759,6 +772,7 @@ class TrainEngine:
         ``(k, local_batch)``. Returns the per-step losses ``(k,)``."""
         if self._jit_train_multi is None:
             if self.comms is not None:
+                self._declare_comms_accounting()
                 self._jit_train_multi = self._wrap(
                     "train_multi", self._comms_train_multi_step,
                     donate_argnums=self._comms_donate(),

@@ -18,9 +18,12 @@ instead).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu")
 
 
 class KerasConversionError(ValueError):
@@ -291,8 +294,10 @@ def build_flax_from_keras(model):
     cfg = {}
     try:
         cfg = model.get_config()
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — arbitrary user get_config
+        logger.warning("model.get_config() failed (%s: %s); treating the "
+                       "model as a Sequential layer chain",
+                       type(e).__name__, e)
     if isinstance(cfg, dict) and "input_layers" in cfg:
         return build_flax_from_keras_graph(model, cfg)
 
@@ -500,8 +505,10 @@ def extract_compile_args(model) -> Tuple[Optional[str], Any, list]:
         names = [m if isinstance(m, str) else getattr(m, "name", None)
                  for m in (raw_metrics if isinstance(raw_metrics, list)
                            else [])]
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — arbitrary user metric objects
+        logger.warning("could not read compiled metric names (%s: %s); "
+                       "continuing without converted metrics",
+                       type(e).__name__, e)
     table = {"accuracy": "accuracy", "acc": "accuracy", "mae": "mae",
              "mse": "mse", "auc": "auc",
              "sparse_categorical_accuracy": "sparse_categorical_accuracy",

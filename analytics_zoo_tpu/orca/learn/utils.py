@@ -217,8 +217,12 @@ def estimate_step_compute_s(jitted, args, devices) -> Optional[float]:
         peak = max((peak_bf16_flops(d) for d in devices), default=0.0)
         if flops > 0 and peak > 0:
             return flops / (ASSUMED_TRAIN_MFU * peak)
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — estimate is advisory
+        # without the estimate the fuse gate degrades to measured step
+        # time only — coarser checkpoint/preemption cadence, so say so
+        logger.warning("step-compute estimate unavailable (%s: %s); "
+                       "fuse gate falls back to measured step time",
+                       type(e).__name__, e)
     return None
 
 

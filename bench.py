@@ -1607,8 +1607,10 @@ def _init_context_cpu_fallback():
             # jax caches failed backend init; drop it so the retry
             # actually re-probes the driver
             jax.clear_backends()
-        except Exception:               # noqa: BLE001 — best-effort
-            pass
+        except Exception as drop_err:   # noqa: BLE001 — best-effort
+            print(f"bench: clear_backends failed "
+                  f"({type(drop_err).__name__}: {drop_err}); retrying "
+                  f"against the cached backend", file=sys.stderr)
 
     try:
         policy.call(jax.devices, on_retry=_drop_cached_backend)
@@ -1651,14 +1653,17 @@ def _force_cpu_backend(jax):
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:                   # noqa: BLE001 — best-effort
-        pass
+    except Exception as e:              # noqa: BLE001 — best-effort
+        print(f"bench: jax_platforms config flip failed "
+              f"({type(e).__name__}: {e}); relying on the env var",
+              file=sys.stderr)
     try:
         # jax caches failed backend init; drop it so the retry actually
         # re-probes the driver
         jax.clear_backends()
-    except Exception:                   # noqa: BLE001 — best-effort
-        pass
+    except Exception as e:              # noqa: BLE001 — best-effort
+        print(f"bench: clear_backends failed ({type(e).__name__}: {e}); "
+              f"a cached backend may survive the CPU flip", file=sys.stderr)
 
 
 def main():

@@ -195,4 +195,44 @@ print("RESILIENCE=" + json.dumps({
     "shed_open": res["shed_open"],
     "breaker_state": res["breaker"]["state"]}))
 EOF
+# analysis-plane snapshot: repo lint findings, golden program-contract
+# drift, and the HLO linter's hook report from a bucketed comms fit on the
+# 8-device simulated mesh (never affects the exit code)
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'EOF' 2>/dev/null || true
+import json
+import numpy as np
+import flax.linen as nn
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.analysis import golden, repolint
+from analytics_zoo_tpu.analysis.hlo_lint import lint_report
+from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+
+init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+
+repo_findings = repolint.lint_paths(repolint.repo_roots())
+golden_ok, golden_delta = golden.check()
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(1)(x)[:, 0]
+
+rng = np.random.RandomState(0)
+est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
+                   sharded_update=True,
+                   config={"steps_per_dispatch": 1, "grad_bucket_mb": 4.0})
+est.fit({"x": rng.rand(128, 8).astype(np.float32),
+         "y": rng.rand(128).astype(np.float32)},
+        epochs=1, batch_size=32, verbose=False)
+hlo = lint_report()
+print("ANALYSIS=" + json.dumps({
+    "repolint_rules": list(repolint.RULES),
+    "repolint_findings": len(repo_findings),
+    "golden_drift": len(golden_delta),
+    "hlo_programs_linted": hlo["programs_linted"],
+    "hlo_findings": hlo["by_rule"],
+    "comms_accounting_verified": hlo["comms_verified"]}))
+EOF
 exit $rc

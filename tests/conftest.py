@@ -27,6 +27,39 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
+# Opt-in runtime race detection for the whole run (ISSUE 9 / STATUS row 37):
+# ZOO_RACE_DETECT=1 routes every threading.Lock/RLock created from here on
+# through the analysis plane's traced wrappers, builds the lock-order graph
+# across all tier-1 tests, and prints the report at session end. Enabled
+# before the planes construct their locks (ckpt writer, infeed pump,
+# watchdog, serving, trial runtime — all built lazily at runtime), but
+# note: module-level locks created while the package __init__ chain
+# imports (e.g. common/context._lock) predate enable() and stay untraced
+# — the detector itself lives inside that package.
+_race_detector = None
+from analytics_zoo_tpu.common import knobs as _zoo_knobs  # noqa: E402
+
+if _zoo_knobs.get("ZOO_RACE_DETECT"):
+    from analytics_zoo_tpu.analysis.races import get_detector
+
+    _race_detector = get_detector()
+    _race_detector.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _race_detector is None:
+        return
+    import json
+
+    _race_detector.disable()
+    rep = _race_detector.report()
+    print("\nRACE_DETECT=" + json.dumps(
+        {"locks": rep["locks"], "acquisitions": rep["acquisitions"],
+         "order_edges": rep["order_edges"],
+         "inversions": rep["inversions"],
+         "unsynchronized": rep["unsynchronized"],
+         "clean": rep["clean"]}))
+
 
 @pytest.fixture()
 def orca_context():
