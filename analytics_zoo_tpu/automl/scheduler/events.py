@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ...obs import trace as _trace
+
 __all__ = ["EventLog"]
 
 
@@ -57,6 +59,13 @@ class EventLog:
 
     def emit(self, event: str, **fields):
         rec = {"t": round(time.time(), 3), "event": event}
+        # obs plane: when tracing is armed, every event carries the trace
+        # id of the span it was emitted under (the per-trial span for
+        # worker-thread events), so study_events.jsonl lines join against
+        # the Perfetto timeline and the span ring
+        tid = _trace.current_trace_id()
+        if tid:
+            rec["trace"] = tid
         rec.update({k: _jsonable(v) for k, v in fields.items()})
         with self._lock:
             self.counts[event] += 1

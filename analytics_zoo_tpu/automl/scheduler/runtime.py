@@ -57,6 +57,7 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional
 
+from ...obs import trace as _trace
 from .asha import AshaBracket
 from .events import EventLog, _jsonable
 from .lease import DeviceLeaseManager
@@ -477,6 +478,14 @@ class TrialRuntime:
 
     # --- one scheduling slice (runs on a worker thread) ---------------------
     def _run_slice(self, trial) -> Dict[str, Any]:
+        # per-trial trace id (obs plane): every study event emitted on this
+        # worker thread — trial_start, reports, pause/retry, trial_done —
+        # is stamped with it in study_events.jsonl (EventLog.emit), and the
+        # trial's fit/infeed/ckpt spans all chain under it
+        with _trace.span("trial", trial=trial.trial_id):
+            return self._run_slice_traced(trial)
+
+    def _run_slice_traced(self, trial) -> Dict[str, Any]:
         rec = self._rec[trial.trial_id]
         t0 = time.perf_counter()
         start_done = rec["epochs_done"]

@@ -39,6 +39,9 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common import knobs as _knobs
+from ..obs import trace as _trace
+from ..obs.registry import REGISTRY as _REGISTRY
 from ..resilience.retry import RetryPolicy
 from . import format as fmt
 from .format import parse_step  # noqa: F401 — re-exported (ckpt.parse_step)
@@ -50,7 +53,7 @@ logger = logging.getLogger("analytics_zoo_tpu")
 
 class _SaveJob:
     __slots__ = ("step", "name", "score", "meta", "skeleton", "leaves",
-                 "done", "error", "path", "on_done")
+                 "done", "error", "path", "on_done", "trace")
 
     def __init__(self, step, name, score, meta, skeleton, leaves, path,
                  on_done=None):
@@ -64,6 +67,9 @@ class _SaveJob:
         self.on_done = on_done
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
+        # trace handoff: the save()-calling thread's span context, so the
+        # writer thread's ckpt.write span chains to the training trace
+        self.trace = _trace.token()
 
 
 class CheckpointPlane:
@@ -85,6 +91,11 @@ class CheckpointPlane:
         self.async_save = async_save
         self.fsync = fsync
         self.stats = stats if stats is not None else CkptStats()
+        if _knobs.get("ZOO_OBS"):
+            # obs plane: this plane's counters on the unified registry
+            # (weak — a closed/collected plane leaves the exposition);
+            # the dict API (data_pipeline_stats()["ckpt"]) stays the source
+            _REGISTRY.register_object("zoo_ckpt", self.stats)
         self.store = BlobStore(os.path.join(root, fmt.BLOB_DIR))
         self._q: "queue.Queue[Optional[_SaveJob]]" = queue.Queue(
             maxsize=max(1, int(max_inflight)))
@@ -186,6 +197,10 @@ class CheckpointPlane:
 
     def _write(self, job: _SaveJob):
         """Blob writes + atomic manifest commit + retention (writer side)."""
+        with _trace.span_under(job.trace, "ckpt.write", step=job.step):
+            self._write_job(job)
+
+    def _write_job(self, job: _SaveJob):
         try:
             leaf_recs: List[Dict] = []
             for arr in job.leaves:

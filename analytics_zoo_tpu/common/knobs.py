@@ -113,6 +113,21 @@ _KNOBS = [
     _k("ZOO_BENCH_FORCED_CPU", "bool", False, "bench",
        "Internal marker set by bench.py's guarded re-exec after TPU init "
        "failure (prevents a retry loop)."),
+    # --- observability plane ------------------------------------------------
+    _k("ZOO_OBS", "bool", True, "obs",
+       "Register plane stats objects (PipelineStats, CkptStats) as "
+       "collector adapters on the unified registry; 0 decouples them "
+       "from the exposition. Registry-native counters (serving, "
+       "resilience) ARE those planes' own store and stay on."),
+    _k("ZOO_TRACE", "bool", False, "obs",
+       "Arm structured span tracing at import (one trace id across "
+       "fit/infeed/ckpt/supervisor/serving; export via zoo-metrics)."),
+    _k("ZOO_TRACE_RING", "int", 4096, "obs",
+       "Span ring-buffer capacity; the oldest spans are evicted, never "
+       "the process."),
+    _k("ZOO_TRACE_PERFETTO", "str", None, "obs",
+       "Path to write the span ring as Chrome/Perfetto trace_event JSON "
+       "at process exit (implies arming, like ZOO_TRACE=1)."),
     # --- analysis plane -----------------------------------------------------
     _k("ZOO_HLO_LINT", "str", "warn", "analysis",
        "StableHLO linter on every compile-plane lowering: warn (log + "

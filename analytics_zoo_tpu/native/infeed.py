@@ -38,6 +38,9 @@ from typing import Callable, Iterator, Optional
 
 import jax
 
+from ..common import knobs as _knobs
+from ..obs import trace as _trace
+from ..obs.registry import REGISTRY as _REGISTRY
 from .transfer import MAX_H2D_LANES, default_h2d_lanes
 
 _STOP = object()
@@ -75,6 +78,14 @@ class PipelineStats:
     def __init__(self):
         self._lock = threading.Lock()
         self.reset()
+        # ZOO_OBS gates the obs-plane coupling only (the counters are
+        # unchanged either way), read per-construction like ckpt/plane.py
+        # so toggling the knob in-process is honored
+        if _knobs.get("ZOO_OBS"):
+            # obs plane: expose this instance's counters on the unified
+            # registry (weakly — a dead estimator's stats drop out of the
+            # /metrics.prom exposition); the dict API stays the source
+            _REGISTRY.register_object("zoo_infeed", self)
 
     def reset(self):
         with self._lock:
@@ -266,26 +277,33 @@ class InfeedPump:
                            if max_lanes is not None else MAX_H2D_LANES)
         self.stats = stats if stats is not None else PipelineStats()
         self.stats.observe_lanes(self._lanes)
+        self._trace_token = None    # captured per-epoch at __iter__
         self._budget = host_mem_budget if host_mem_budget is not None else (
             int(os.environ.get("ZOO_INFEED_BUDGET_MB",
                                str(_DEFAULT_BUDGET_MB))) << 20)
 
     # --- producer side -------------------------------------------------------
+    # trace spans here use the handoff token captured at __iter__ time on
+    # the CONSUMER thread (inside fit's epoch span): the assembly workers
+    # and transfer lanes are pool threads where a contextvar alone would
+    # lose the trace. Disarmed cost: one flag check per call.
     def _assemble(self, task):
-        t0 = time.perf_counter()
-        batch = task()
-        self.stats.add("assemble", time.perf_counter() - t0,
-                       nbytes=_batch_nbytes(batch))
+        with _trace.span_under(self._trace_token, "infeed.assemble"):
+            t0 = time.perf_counter()
+            batch = task()
+            self.stats.add("assemble", time.perf_counter() - t0,
+                           nbytes=_batch_nbytes(batch))
         return batch
 
     def _transfer(self, host_batch):
         """One lane's work: stage a whole batch into HBM. Runs concurrently
         on up to ``lanes`` threads; ordering is restored by the caller's
         FIFO future window."""
-        t0 = time.perf_counter()
-        dev = self._device_put(host_batch)
-        self.stats.add("h2d", time.perf_counter() - t0,
-                       nbytes=_batch_nbytes(host_batch))
+        with _trace.span_under(self._trace_token, "infeed.h2d"):
+            t0 = time.perf_counter()
+            dev = self._device_put(host_batch)
+            self.stats.add("h2d", time.perf_counter() - t0,
+                           nbytes=_batch_nbytes(host_batch))
         return dev
 
     def _producer(self, q: _FlexQueue, err: list):
@@ -383,6 +401,10 @@ class InfeedPump:
             self.stats.observe_lanes(self._lanes, grew=True)
 
     def __iter__(self):
+        # thread-handoff token: the consumer thread drives iteration from
+        # inside fit's epoch span; the producer + lane threads parent their
+        # spans here so one trace id covers fit → assemble → h2d
+        self._trace_token = _trace.token()
         q = _FlexQueue(self._depth)
         self.stats.observe_depth(q.capacity)
         err: list = []

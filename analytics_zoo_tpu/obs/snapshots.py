@@ -1,0 +1,319 @@
+"""Per-plane tier-1 snapshot lines — the one codepath behind
+``zoo-metrics snapshot <plane>`` and ``scripts/run_tier1.sh``.
+
+Each function runs a tiny CPU workload through the real production path of
+one plane and prints a single ``NAME=<json>`` line (``TRANSFER_PLANE=``,
+``CKPT_PLANE=``, ``COMMS_PLANE=``, ``RESILIENCE=``, ``ANALYSIS=``,
+``OBS=``). These used to live as five bespoke ``python - <<EOF`` heredocs
+inside run_tier1.sh; the script now loops over
+``python -m analytics_zoo_tpu.obs snapshot <plane>`` so the
+snapshot logic is importable, testable and shared with the CLI.
+
+One process per plane (the comms/analysis snapshots need the 8-device
+simulated mesh, which must be configured before the JAX backend first
+initializes — :func:`_ensure_sim_devices` appends the XLA flag when the
+caller has not)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import Dict
+
+__all__ = ["run", "PLANES"]
+
+
+def _emit(label: str, payload: Dict) -> int:
+    print(label + "=" + json.dumps(payload))
+    return 0
+
+
+def _ensure_sim_devices(n: int = 8):
+    """Force the n-device virtual CPU mesh. Must run before the first JAX
+    backend initialization (importing jax is fine; creating devices is
+    not) — the CLI entry satisfies that."""
+    # strip-then-append (same as bench.py's child env): an ambient
+    # =2 left over from other tests must not shrink the documented
+    # 8-dev mesh the comms/analysis snapshots assume
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def snapshot_transfer() -> int:
+    """Per-stage MB/s + transfer_limited verdict from a tiny CPU fit
+    through the production pump."""
+    import flax.linen as nn
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..orca.learn.estimator import TPUEstimator
+    from ..orca.learn.prologue import BatchPrologue, image_normalize
+
+    init_orca_context("local")
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    rng = np.random.RandomState(0)
+    est = TPUEstimator(M(), loss="sparse_categorical_crossentropy",
+                       optimizer="adam", config={"steps_per_dispatch": 1},
+                       prologue=BatchPrologue(x=(image_normalize(),)))
+    est.fit({"x": rng.randint(0, 256, (256, 8, 8, 3), np.uint8),
+             "y": rng.randint(0, 4, 256).astype(np.int32)},
+            epochs=1, batch_size=32, verbose=False)
+    snap = est.data_pipeline_stats()
+    keys = ("assemble_MBps", "h2d_MBps", "h2d_bytes", "lanes",
+            "transfer_limited")
+    return _emit("TRANSFER_PLANE", {k: snap[k] for k in keys if k in snap})
+
+
+def snapshot_ckpt() -> int:
+    """Async save latency (on-loop stall vs hidden write) + dedup ratio
+    from a tiny fit checkpointing through the plane."""
+    import flax.linen as nn
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..orca.learn.estimator import TPUEstimator
+    from ..orca.learn.trigger import SeveralIteration
+
+    init_orca_context("local")
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as d:
+        est = TPUEstimator(M(), loss="mse", optimizer="adam", model_dir=d,
+                           config={"steps_per_dispatch": 1})
+        est.fit({"x": rng.rand(256, 8).astype(np.float32),
+                 "y": rng.rand(256).astype(np.float32)},
+                epochs=2, batch_size=32,
+                checkpoint_trigger=SeveralIteration(4), verbose=False)
+        snap = est.data_pipeline_stats().get("ckpt", {})
+        est.shutdown()
+    keys = ("saves", "stall_s", "hidden_s", "write_s", "stall_frac",
+            "dedup_ratio", "bytes_written", "bytes_deduped")
+    return _emit("CKPT_PLANE", {k: snap[k] for k in keys if k in snap})
+
+
+def snapshot_comms() -> int:
+    """Bucketed reduce-scatter + ZeRO-1 sharded update on the 8-device
+    simulated mesh — buckets, wire bytes/step, collective launches,
+    bit-identity to flat psum."""
+    _ensure_sim_devices()
+    import flax.linen as nn
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..orca.learn.estimator import TPUEstimator
+
+    init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(32)(x))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    data = {"x": rng.rand(256, 8).astype(np.float32),
+            "y": rng.rand(256).astype(np.float32)}
+
+    def run_cfg(cfg, **kw):
+        est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1, **cfg}, **kw)
+        stats = est.fit(dict(data), epochs=1, batch_size=32, verbose=False)
+        return [s["train_loss"] for s in stats], est
+
+    lf, _ = run_cfg({"comms_plane": True})
+    lb, est = run_cfg({"grad_bucket_mb": 4.0}, sharded_update=True)
+    snap = est.data_pipeline_stats()["comms"]
+    keys = ("buckets", "collectives_per_step", "wire_bytes_per_step",
+            "grad_leaves", "sharded_update", "wire_dtype",
+            "opt_shard_elems")
+    out = {k: snap[k] for k in keys if k in snap}
+    out["bit_identical_to_flat"] = lf == lb
+    return _emit("COMMS_PLANE", out)
+
+
+def snapshot_resilience() -> int:
+    """One injected mid-fit fault through the training supervisor + a
+    shed/breaker pass through the serving engine."""
+    import time
+
+    import flax.linen as nn
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..orca.learn.estimator import TPUEstimator
+    from ..resilience import TrainingSupervisor, faults
+    from ..serving import ClusterServing, InMemoryBroker
+    from ..serving.codecs import encode_payload
+
+    init_orca_context("local")
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    data = {"x": rng.rand(64, 8).astype(np.float32),
+            "y": rng.rand(64).astype(np.float32)}
+    with tempfile.TemporaryDirectory() as d:
+        sup = TrainingSupervisor(
+            lambda: TPUEstimator(M(), loss="mse", optimizer="adam",
+                                 model_dir=d, seed=0,
+                                 config={"steps_per_dispatch": 1}),
+            model_dir=d, max_restarts=2)
+        sup.retry_policy.base_delay_s = 0.05
+        with faults.inject("engine.dispatch", count=1, skip=3):
+            report = sup.fit(dict(data), epochs=2, batch_size=32)
+        sup.estimator.shutdown()
+
+    class _Echo:
+        def predict(self, x):
+            return np.asarray(x)
+
+    broker = InMemoryBroker()
+    cs = ClusterServing(_Echo(), queue=broker, batch_size=4)
+    for i in range(2):
+        broker.enqueue(f"x{i}", encode_payload(
+            np.ones(2, np.float32), meta={"deadline": time.time() - 1}))
+    for i in range(2):
+        broker.enqueue(f"l{i}", encode_payload(
+            np.ones(2, np.float32), meta={"deadline": time.time() + 30}))
+    cs.start()
+    for i in range(2):
+        broker.get_result(f"l{i}", 10.0)
+        broker.get_result(f"x{i}", 10.0)
+    res = cs.metrics()["resilience"]
+    cs.drain(timeout_s=10.0)
+    return _emit("RESILIENCE", {
+        "restarts": report["restarts"], "hangs": report["hangs"],
+        "crashes": report["crashes"],
+        "steps_replayed": report["steps_replayed"],
+        "downtime_s": round(report["downtime_s"], 3),
+        "bit_exact_resume": report["completed"],
+        "shed_expired": res["shed_expired"],
+        "shed_open": res["shed_open"],
+        "breaker_state": res["breaker"]["state"]})
+
+
+def snapshot_analysis() -> int:
+    """Repo lint findings, golden program-contract drift, and the HLO
+    linter's hook report from a bucketed comms fit on the simulated
+    mesh."""
+    _ensure_sim_devices()
+    import flax.linen as nn
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..analysis import golden, repolint
+    from ..analysis.hlo_lint import lint_report
+    from ..orca.learn.estimator import TPUEstimator
+
+    init_orca_context("cpu-sim", mesh_axes={"dp": -1})
+
+    repo_findings = repolint.lint_paths(repolint.repo_roots())
+    golden_ok, golden_delta = golden.check()
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
+                       sharded_update=True,
+                       config={"steps_per_dispatch": 1,
+                               "grad_bucket_mb": 4.0})
+    est.fit({"x": rng.rand(128, 8).astype(np.float32),
+             "y": rng.rand(128).astype(np.float32)},
+            epochs=1, batch_size=32, verbose=False)
+    hlo = lint_report()
+    return _emit("ANALYSIS", {
+        "repolint_rules": list(repolint.RULES),
+        "repolint_findings": len(repo_findings),
+        "golden_drift": len(golden_delta),
+        "hlo_programs_linted": hlo["programs_linted"],
+        "hlo_findings": hlo["by_rule"],
+        "comms_accounting_verified": hlo["comms_verified"]})
+
+
+def snapshot_obs() -> int:
+    """The observability plane's own health line: a traced 8-step fit with
+    a checkpoint, then — spans recorded, one trace id across
+    fit → engine dispatch → infeed lane → ckpt writer, metric series
+    registered, and both exporters round-tripping."""
+    from . import trace
+    from .export import (parse_exposition, perfetto_trace, prometheus_text)
+    from .registry import REGISTRY
+
+    trace.clear()
+    trace.arm()
+    from .export import _demo_fit
+    _demo_fit(8)
+    spans = trace.spans()
+    by_name: Dict[str, list] = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    fit_traces = {s.trace_id for s in by_name.get("fit", ())}
+    chained = [n for n in ("engine.dispatch", "infeed.h2d", "ckpt.write")
+               if any(s.trace_id in fit_traces
+                      for s in by_name.get(n, ()))]
+    try:
+        prom = parse_exposition(prometheus_text())
+        exporter_ok = len(prom) > 0
+    except ValueError:
+        exporter_ok = False
+    doc = perfetto_trace(spans)
+    perfetto_ok = bool(doc["traceEvents"]) and all(
+        e["ph"] in ("X", "M", "C") for e in doc["traceEvents"])
+    return _emit("OBS", {
+        "spans": len(spans),
+        "span_names": sorted(by_name),
+        "one_trace_across": chained,
+        "trace_ok": len(chained) == 3,
+        "metrics_registered": len(REGISTRY.families()),
+        "metric_series": len(REGISTRY.snapshot()),
+        "exporter_ok": bool(exporter_ok),
+        "perfetto_ok": perfetto_ok})
+
+
+PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,
+          "comms": snapshot_comms, "resilience": snapshot_resilience,
+          "analysis": snapshot_analysis, "obs": snapshot_obs}
+
+
+def run(plane: str) -> int:
+    fn = PLANES.get(plane)
+    if fn is None:
+        print(f"unknown plane {plane!r}; choose from {sorted(PLANES)}",
+              file=sys.stderr)
+        return 2
+    return fn()
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) != 1:
+        print("usage: python -m analytics_zoo_tpu.obs.snapshots <plane>",
+              file=sys.stderr)
+        return 2
+    return run(args[0])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,6 +30,7 @@ from flax.core import FrozenDict
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...obs import trace as _trace
 from ...parallel import comms as comms_lib
 from ...resilience import faults as _faults
 from ...resilience import watchdog as _watchdog
@@ -749,15 +750,18 @@ class TrainEngine:
         token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
         try:
-            _faults.fire("engine.dispatch")
-            if self.comms is not None:
-                (self.params, self.extra_vars, self.opt_state,
-                 self.comms_resid, loss) = self._jit_train(
-                    *self.train_step_args(batch))
-                self.comms_steps += 1
-            else:
-                self.params, self.extra_vars, self.opt_state, loss = \
-                    self._jit_train(*self.train_step_args(batch))
+            # obs span (one flag check disarmed): the per-step device-time
+            # segment the Perfetto timeline renders, step-indexed
+            with _trace.span("engine.dispatch", step=self.step):
+                _faults.fire("engine.dispatch")
+                if self.comms is not None:
+                    (self.params, self.extra_vars, self.opt_state,
+                     self.comms_resid, loss) = self._jit_train(
+                        *self.train_step_args(batch))
+                    self.comms_steps += 1
+                else:
+                    self.params, self.extra_vars, self.opt_state, loss = \
+                        self._jit_train(*self.train_step_args(batch))
         finally:
             if token is not None:
                 wd.exit(token)
@@ -785,14 +789,16 @@ class TrainEngine:
         token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
         try:
-            _faults.fire("engine.dispatch")
-            if self.comms is not None:
-                (self.params, self.extra_vars, self.opt_state,
-                 self.comms_resid, losses) = self._jit_train_multi(
-                    *self.train_step_args(batch))
-            else:
-                self.params, self.extra_vars, self.opt_state, losses = \
-                    self._jit_train_multi(*self.train_step_args(batch))
+            with _trace.span("engine.dispatch", step=self.step,
+                             fused=int(batch.fused)):
+                _faults.fire("engine.dispatch")
+                if self.comms is not None:
+                    (self.params, self.extra_vars, self.opt_state,
+                     self.comms_resid, losses) = self._jit_train_multi(
+                        *self.train_step_args(batch))
+                else:
+                    self.params, self.extra_vars, self.opt_state, losses = \
+                        self._jit_train_multi(*self.train_step_args(batch))
         finally:
             if token is not None:
                 wd.exit(token)

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 
 from ...common.context import get_context
+from ...obs import trace as _trace
 from ..data.shard import HostXShards
 from . import utils as learn_utils
 from .engine import TrainEngine
@@ -331,12 +332,18 @@ class TPUEstimator:
         try:
             with (watcher if watcher is not None
                   else contextlib.nullcontext()):
-                return self._fit_loop(it, epochs, steps_per_epoch,
-                                      batch_size, feature_cols, label_cols,
-                                      validation_data, checkpoint_trigger,
-                                      profile, verbose, can_recover,
-                                      retries_left, epoch_stats, watcher,
-                                      fuse)
+                # root span of the training trace (obs plane): epoch,
+                # dispatch, infeed-lane and ckpt-writer spans all chain
+                # under this trace id
+                with _trace.span("fit", epochs=epochs,
+                                 initial_epoch=initial_epoch):
+                    return self._fit_loop(it, epochs, steps_per_epoch,
+                                          batch_size, feature_cols,
+                                          label_cols, validation_data,
+                                          checkpoint_trigger, profile,
+                                          verbose, can_recover,
+                                          retries_left, epoch_stats,
+                                          watcher, fuse)
         finally:
             # returning from fit() means every queued checkpoint is
             # durable — resumers (AutoML pause/resume, a supervisor
@@ -506,9 +513,10 @@ class TPUEstimator:
         ep = 0
         while ep < epochs:
             try:
-                stats = self._fit_epoch(it, ep, steps_per_epoch,
-                                        checkpoint_trigger, profile,
-                                        watcher, fuse)
+                with _trace.span("epoch", epoch=ep):
+                    stats = self._fit_epoch(it, ep, steps_per_epoch,
+                                            checkpoint_trigger, profile,
+                                            watcher, fuse)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:

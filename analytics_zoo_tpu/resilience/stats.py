@@ -9,36 +9,55 @@ existing observability surfaces pick the snapshot up —
 ``metrics()["resilience"]`` / HTTP ``/metrics``, and
 ``TrialRuntime.summary()["resilience"]`` — so a pod operator reads fault
 history in the same place as throughput.
+
+Since the observability plane (PR 10) the backing store is the unified
+metrics registry: every ``add(key)`` increments the
+``zoo_resilience_events_total{event=key}`` counter family in
+``analytics_zoo_tpu.obs.registry.REGISTRY``, and :meth:`ResilienceStats.
+snapshot` is a *view over the registry* — the dict API is unchanged
+(empty until something fires), and the same counters now also serve on
+the Prometheus exposition (``/metrics.prom``, ``zoo-metrics dump``).
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
 
+from ..obs.registry import REGISTRY
+
 __all__ = ["ResilienceStats", "STATS", "resilience_snapshot"]
+
+_FAMILY_NAME = "zoo_resilience_events_total"
+_FAMILY_DOC = ("resilience-plane events by kind: fault fires, watchdog "
+               "trips, supervisor restarts, retries, serving sheds/drains")
 
 
 class ResilienceStats:
     """Monotonic named counters; empty snapshot until something happens, so
-    surfaces can omit the section on healthy runs."""
+    surfaces can omit the section on healthy runs. Backed by one registry
+    counter family — instances share it (the process-wide :data:`STATS` is
+    the only instance the stack creates)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, float] = {}
+        self._family = REGISTRY.counter(_FAMILY_NAME, _FAMILY_DOC,
+                                        labelnames=("event",))
 
     def add(self, key: str, n: float = 1):
-        with self._lock:
-            self._counts[key] = self._counts.get(key, 0) + n
+        # labels() is itself a get-or-create cache (one dict get when the
+        # child exists) — no second cache layer needed
+        self._family.labels(event=key).inc(n)
 
     def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return {k: (round(v, 6) if isinstance(v, float) else v)
-                    for k, v in sorted(self._counts.items())}
+        out = {}
+        for labels, child in self._family.samples():
+            v = child.value
+            if v:
+                v = int(v) if float(v).is_integer() else round(v, 6)
+                out[labels["event"]] = v
+        return dict(sorted(out.items()))
 
     def reset(self):
-        with self._lock:
-            self._counts.clear()
+        self._family.clear()
 
 
 #: the process-wide table every resilience component reports into

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs import trace as _trace
 from . import watchdog as wd_mod
 from .retry import RetryPolicy
 from .stats import STATS
@@ -136,13 +137,17 @@ class TrainingSupervisor:
         it — recovery rebuilds the estimator, so its late writes land on a
         discarded engine)."""
         box: Dict[str, Any] = {}
+        # trace handoff: the segment runs on a worker thread; adopting the
+        # supervisor's token keeps fit's spans on the supervised trace
+        tok = _trace.token()
 
         def target():
             try:
-                box["stats"] = est.fit(
-                    data, epochs=1, batch_size=batch_size,
-                    initial_epoch=epoch, max_failure_retries=0,
-                    verbose=False, **fit_kwargs)
+                with _trace.adopt(tok):
+                    box["stats"] = est.fit(
+                        data, epochs=1, batch_size=batch_size,
+                        initial_epoch=epoch, max_failure_retries=0,
+                        verbose=False, **fit_kwargs)
             except BaseException as e:      # noqa: BLE001 — classified
                 box["error"] = e
 
@@ -227,7 +232,7 @@ class TrainingSupervisor:
             on_signal=lambda signum: preempted.set())
         self.estimator = est
         try:
-            with watcher:
+            with watcher, _trace.span("supervisor.fit", epochs=epochs):
                 epoch = self._resume(est)
                 while epoch < epochs:
                     wd.reset()
@@ -255,10 +260,16 @@ class TrainingSupervisor:
                     err, kind = outcome["error"], outcome["kind"]
                     failed_step = getattr(
                         getattr(est, "engine", None), "step", 0)
-                    self._teardown(est, err)
-                    est = self._factory()
-                    epoch = self._recover(est, err, kind, failed_step,
-                                          report)
+                    # restart span annotated with the classified fault
+                    # kind (hang|crash) + cause: teardown → rebuild →
+                    # backoff → restore, all one segment on the timeline
+                    with _trace.span("supervisor.restart", kind=kind,
+                                     step=int(failed_step),
+                                     cause=type(err).__name__):
+                        self._teardown(est, err)
+                        est = self._factory()
+                        epoch = self._recover(est, err, kind, failed_step,
+                                              report)
                 self.estimator = est
                 report["completed"] = not report["preempted"] and \
                     epoch >= epochs

@@ -21,6 +21,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import trace as _trace
+from ..obs.registry import REGISTRY, InstancedEvents
 from ..pipeline.inference.inference_model import InferenceModel
 from ..resilience import faults as _faults
 from ..resilience.retry import CircuitBreaker
@@ -97,13 +99,22 @@ class ClusterServing:
         self.breaker = CircuitBreaker(threshold=breaker_threshold,
                                       cooldown_s=breaker_cooldown_s,
                                       name="serving")
-        self._res_lock = threading.Lock()
-        self._res = {"shed_expired": 0, "shed_open": 0, "batch_failures": 0,
-                     "decode_errors": 0}
+        # overload counters live in the unified metrics registry (obs
+        # plane): one family labeled per engine instance, so metrics()'s
+        # dict stays a per-engine view (starting at 0) while /metrics.prom
+        # exposes the same series process-wide
+        self._res_events = InstancedEvents(
+            REGISTRY.counter(
+                "zoo_serving_engine_events_total",
+                "serving-engine overload events: expired/open-circuit "
+                "sheds, batch failures, decode errors",
+                labelnames=("inst", "event")),
+            ("shed_expired", "shed_open", "batch_failures",
+             "decode_errors"))
+        self._res_children = self._res_events.children
 
     def _count(self, key: str, n: int = 1):
-        with self._res_lock:
-            self._res[key] += n
+        self._res_children[key].inc(n)
 
     @property
     def draining(self) -> bool:
@@ -126,8 +137,22 @@ class ClusterServing:
         claimed item gets a result — error payloads for shed/failed ones —
         so frontend fetches never wait out their full timeout on a request
         the engine already gave up on."""
+        t_dec = time.perf_counter()     # span timebase (see record_span)
         try:
-            live = self._decode_and_shed(batch)
+            live, batch_tok = self._decode_and_shed(batch)
+            # the request's trace token rides the payload meta (stamped by
+            # the HTTP frontend inside its serving.request span), so the
+            # decode/batch/dispatch spans recorded on THIS worker thread
+            # chain to the request that enqueued the batch's head — the
+            # Dapper-style cross-process handoff. Retroactive: the token
+            # is only known after decoding. The token comes from the first
+            # decoded item carrying one, shed or live, so a fully-shed batch
+            # (exactly the overload case tracing should explain) still
+            # chains to the shedding request instead of minting an orphan
+            # trace per drain.
+            _trace.record_span("serving.decode", t_dec,
+                               time.perf_counter(),
+                               parent=batch_tok, n=len(batch))
         except Exception as e:  # noqa: BLE001 — injected/decode-stage fault
             self.breaker.record_failure()
             self._count("batch_failures")
@@ -148,7 +173,7 @@ class ClusterServing:
                                        "shed": "circuit_open"}))
             return
         try:
-            self._process(live)
+            self._process(live, batch_tok)
             self.breaker.record_success()
         except Exception as e:  # noqa: BLE001 — serving must not die
             self.breaker.record_failure()
@@ -162,14 +187,19 @@ class ClusterServing:
         """Per-item decode (one malformed record fails itself, not its
         batchmates) + deadline shedding: a request whose ``meta.deadline``
         (absolute epoch seconds, stamped at admission) has passed is
-        answered with an error payload and NEVER reaches the device."""
+        answered with an error payload and NEVER reaches the device.
+        Returns ``(live, trace_token)`` — the token of the first decoded
+        item CARRYING one (shed included), for the batch's spans."""
         live = []
+        batch_tok = None
         with self.timer.time("decode"):
             _faults.fire("serving.decode")  # chaos hook (whole batch)
             now = time.time()
             for item_id, payload in batch:
                 try:
                     data, meta = decode_payload(payload)
+                    if batch_tok is None:
+                        batch_tok = meta.get("trace")
                     # deadline parse is per-item too: a client that sends
                     # meta={"deadline": "soon"} must fail itself, not
                     # feed the breaker and fail its batchmates
@@ -200,11 +230,17 @@ class ClusterServing:
                     self._count("decode_errors")
                     self.broker.put_result(item_id, encode_payload(
                         np.zeros(0), meta={"error": f"bad payload: {e}"}))
-        return live
+        return live, batch_tok
 
-    def _process(self, live):
+    def _process(self, live, batch_tok=None):
         arrays = [a for _, a, _ in live]
-        with self.timer.time("batch"):
+        # one batch = one trace: batch/dispatch/respond parent at the same
+        # token serving.decode joined (_decode_and_shed already scanned
+        # every decoded item, live ones included, so there is no second
+        # place to look when it found none)
+        tok = batch_tok
+        with _trace.span_under(tok, "serving.batch", n=len(live)), \
+                self.timer.time("batch"):
             first = arrays[0]
             if isinstance(first, list):
                 stacked = [np.stack([a[i] for a in arrays])
@@ -230,9 +266,11 @@ class ClusterServing:
                 stacked = [np.stack([a[k] for a in arrays]) for k in order]
             else:
                 stacked = np.stack(arrays)
-        with self.timer.time("inference"):
+        with _trace.span_under(tok, "serving.dispatch", n=len(live)), \
+                self.timer.time("inference"):
             preds = self.model.predict(stacked)
-        with self.timer.time("encode"):
+        with _trace.span_under(tok, "serving.respond"), \
+                self.timer.time("encode"):
             multi = isinstance(preds, (list, tuple))
             for i, (item_id, _arr, _meta) in enumerate(live):
                 if multi:
@@ -266,6 +304,10 @@ class ClusterServing:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        # drop this instance's series from the process exposition —
+        # rebuilt engines must not leak dead-uuid series into every
+        # scrape. The cached children keep serving metrics()'s view.
+        self._res_events.close()
 
     def drain(self, timeout_s: float = 30.0) -> Dict:
         """Graceful shutdown (the SIGTERM path, shared with the training
@@ -279,9 +321,16 @@ class ClusterServing:
         deadline = time.monotonic() + timeout_s
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
+        # short final joins — a wedged worker must not stretch the
+        # caller's SIGTERM grace budget by stop()'s 5s-per-thread joins
         self._stop.set()
         for t in self._threads:
             t.join(timeout=1)
+        # drop this instance's registry series like stop() does — a
+        # supervisor that drain()s and rebuilds must not accumulate
+        # dead-uuid series scrape after scrape; metrics() keeps working
+        # off the cached children for the returned snapshot
+        self._res_events.close()
         snap = self.metrics()
         logger.info("serving drained (records_out=%d, pending=%s): %s",
                     self.records_out,
@@ -297,8 +346,9 @@ class ClusterServing:
     def metrics(self) -> Dict:
         """(reference observability: Flink numRecordsOutPerSecond +
         Timer stats)"""
-        with self._res_lock:
-            res = dict(self._res)
+        # the dict is a view over the registry children (obs plane): same
+        # keys and int values as the pre-registry per-engine dict
+        res = {k: int(c.value) for k, c in self._res_children.items()}
         res["breaker"] = self.breaker.snapshot()
         res["draining"] = self.draining
         out = {"records_out": self.records_out,

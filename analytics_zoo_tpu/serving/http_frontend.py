@@ -25,6 +25,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import trace as _trace
+from ..obs.export import prometheus_text
+from ..obs.registry import REGISTRY, InstancedEvents
 from .codecs import SparseTensor, decode_payload, encode_payload
 from .queue_api import Broker, make_broker
 
@@ -55,11 +58,33 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
     ``X-Timeout-S`` header if tighter) in its payload meta; the engine
     sheds expired requests before device dispatch. ``GET /healthz`` is
     process liveness, ``GET /readyz`` flips 503 while draining or while
-    the serving circuit breaker is open."""
+    the serving circuit breaker is open.
+
+    Observability (obs plane): ``GET /metrics.prom`` serves the unified
+    registry as Prometheus text exposition next to the byte-compatible
+    JSON body; with tracing armed (``ZOO_TRACE=1``) each predict opens a
+    ``serving.request`` span whose token rides the payload meta so the
+    engine's decode/batch/dispatch spans chain to it."""
     from aiohttp import web
 
     broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
-    counters = {"rejected_429": 0, "expired_results": 0}
+    # admission counters live in the unified metrics registry (obs plane),
+    # labeled per app instance so this app's JSON /metrics body still
+    # starts at 0 (byte-compatible with the pre-registry per-app dict)
+    # while /metrics.prom exposes the same series
+    events = InstancedEvents(
+        REGISTRY.counter(
+            "zoo_serving_http_events_total",
+            "HTTP-frontend admission events: 429 rejections, expired "
+            "results observed at fetch", labelnames=("inst", "event")),
+        ("rejected_429", "expired_results"))
+    counters = events.children
+
+    async def _drop_counter_series(app):
+        # app teardown drops this instance's series from the exposition so
+        # rebuilt apps never leak dead-uuid series (cached children keep
+        # serving the JSON view if anything still holds the app)
+        events.close()
 
     @web.middleware
     async def auth_middleware(request, handler):
@@ -115,14 +140,31 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         # results observed at fetch) merge into the engine's resilience
         # section; process-wide fault/retry/watchdog counters ride along
         res = dict(body.get("resilience") or {})
-        res.update(counters)
+        res.update({k: int(c.value) for k, c in counters.items()})
         glob = resilience_snapshot()
         if glob:
             res["process"] = glob
         body["resilience"] = res
         return web.json_response(body)
 
+    async def metrics_prom(request):
+        # Prometheus text exposition of the unified registry (obs plane):
+        # every plane's counters — serving admission/engine events,
+        # resilience events, compile/transfer/ckpt collector adapters —
+        # next to the byte-compatible JSON body above. Serialization walks
+        # in-process counters only (no broker round-trip), so it stays on
+        # the event loop.
+        return web.Response(text=prometheus_text(),
+                            content_type="text/plain")
+
     async def predict(request):
+        # root span of the serving trace: request → decode → batch →
+        # device-dispatch → respond. Its token rides each instance's
+        # payload meta so the engine's worker-thread spans chain to it.
+        with _trace.span("serving.request", method=request.method):
+            return await _predict(request)
+
+    async def _predict(request):
         if serving is not None and serving.draining:
             # stop accepting during the SIGTERM grace window; admitted
             # requests are still drained to completion
@@ -140,7 +182,7 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
             # Retry-After is a coarse hint: one batch-drain interval.
             backlog = await loop.run_in_executor(None, broker.pending)
             if backlog + len(instances) > max_pending:
-                counters["rejected_429"] += 1
+                counters["rejected_429"].inc()
                 return web.json_response(
                     {"error": "queue full", "pending": backlog,
                      "max_pending": max_pending},
@@ -177,11 +219,16 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
                 return web.json_response(
                     {"error": f"bad X-Timeout-S: {hdr!r}"}, status=400)
         deadline = time.time() + eff_timeout
+        # trace handoff: the request span's token rides the payload meta so
+        # the batcher thread's decode/dispatch spans chain to this request
+        tok = _trace.token()
         uris = []
         for data in parsed:
             uri = uuid.uuid4().hex
-            broker.enqueue(uri, encode_payload(
-                data, meta={"uri": uri, "deadline": deadline}))
+            meta = {"uri": uri, "deadline": deadline}
+            if tok:
+                meta["trace"] = tok
+            broker.enqueue(uri, encode_payload(data, meta=meta))
             uris.append(uri)
 
         def fetch(uri):
@@ -198,9 +245,11 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
 
         fetched = await asyncio.gather(
             *[loop.run_in_executor(None, fetch, u) for u in uris])
-        # counters mutate on the event loop only — executor threads racing
-        # a bare dict increment would drop counts
-        counters["expired_results"] += sum(exp for _, exp in fetched)
+        # registry children are internally locked, so this is safe from
+        # any thread (the old bare-dict increment had to stay on the loop)
+        n_expired = sum(exp for _, exp in fetched)
+        if n_expired:
+            counters["expired_results"].inc(n_expired)
         return web.json_response({"predictions": [r for r, _ in fetched]})
 
     async def model_secure(request):
@@ -222,11 +271,13 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
                                  "to put in app state")
 
     app = web.Application(middlewares=[auth_middleware])
+    app.on_cleanup.append(_drop_counter_series)
     app["model_secure"] = {}        # mutable holder, registered pre-startup
     app.router.add_get("/", index)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/metrics.prom", metrics_prom)
     app.router.add_post("/predict", predict)
     app.router.add_put("/predict", predict)
     app.router.add_post("/model-secure", model_secure)

@@ -28,211 +28,16 @@ echo "DOTS_FAILED=$(printf '%s\n' "$fails" | grep -c . )"
 if [ -n "$fails" ]; then
     printf 'DOTS_FAILED_ID=%s\n' $fails
 fi
-# transfer-plane snapshot: per-stage MB/s + transfer_limited verdict from a
-# tiny CPU fit through the production pump (never affects the exit code)
-env JAX_PLATFORMS=cpu python - <<'EOF' 2>/dev/null || true
-import json
-import numpy as np
-from analytics_zoo_tpu import init_orca_context
-from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
-from analytics_zoo_tpu.orca.learn.prologue import BatchPrologue, image_normalize
-import flax.linen as nn
-
-init_orca_context("local")
-
-class M(nn.Module):
-    @nn.compact
-    def __call__(self, x):
-        return nn.Dense(4)(x.reshape((x.shape[0], -1)))
-
-rng = np.random.RandomState(0)
-est = TPUEstimator(M(), loss="sparse_categorical_crossentropy",
-                   optimizer="adam", config={"steps_per_dispatch": 1},
-                   prologue=BatchPrologue(x=(image_normalize(),)))
-est.fit({"x": rng.randint(0, 256, (256, 8, 8, 3), np.uint8),
-         "y": rng.randint(0, 4, 256).astype(np.int32)},
-        epochs=1, batch_size=32, verbose=False)
-snap = est.data_pipeline_stats()
-keys = ("assemble_MBps", "h2d_MBps", "h2d_bytes", "lanes",
-        "transfer_limited")
-print("TRANSFER_PLANE=" + json.dumps(
-    {k: snap[k] for k in keys if k in snap}))
-EOF
-# checkpoint-plane snapshot: async save latency (on-loop stall vs hidden
-# write) + dedup ratio from a tiny fit checkpointing through the plane
-# (never affects the exit code)
-env JAX_PLATFORMS=cpu python - <<'EOF' 2>/dev/null || true
-import json
-import tempfile
-import numpy as np
-import flax.linen as nn
-from analytics_zoo_tpu import init_orca_context
-from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
-from analytics_zoo_tpu.orca.learn.trigger import SeveralIteration
-
-init_orca_context("local")
-
-class M(nn.Module):
-    @nn.compact
-    def __call__(self, x):
-        return nn.Dense(1)(x)[:, 0]
-
-rng = np.random.RandomState(0)
-with tempfile.TemporaryDirectory() as d:
-    est = TPUEstimator(M(), loss="mse", optimizer="adam", model_dir=d,
-                       config={"steps_per_dispatch": 1})
-    est.fit({"x": rng.rand(256, 8).astype(np.float32),
-             "y": rng.rand(256).astype(np.float32)},
-            epochs=2, batch_size=32,
-            checkpoint_trigger=SeveralIteration(4), verbose=False)
-    snap = est.data_pipeline_stats().get("ckpt", {})
-    est.shutdown()
-keys = ("saves", "stall_s", "hidden_s", "write_s", "stall_frac",
-        "dedup_ratio", "bytes_written", "bytes_deduped")
-print("CKPT_PLANE=" + json.dumps({k: snap[k] for k in keys if k in snap}))
-EOF
-# comms-plane snapshot: bucketed reduce-scatter + ZeRO-1 sharded update on
-# the 8-device simulated mesh — buckets, wire bytes/step, collective
-# launches, sharded on/off, bit-identity to flat psum
-# (never affects the exit code)
-env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python - <<'EOF' 2>/dev/null || true
-import json
-import numpy as np
-import flax.linen as nn
-from analytics_zoo_tpu import init_orca_context
-from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
-
-init_orca_context("cpu-sim", mesh_axes={"dp": -1})
-
-class M(nn.Module):
-    @nn.compact
-    def __call__(self, x):
-        x = nn.relu(nn.Dense(32)(x))
-        x = nn.relu(nn.Dense(16)(x))
-        return nn.Dense(1)(x)[:, 0]
-
-rng = np.random.RandomState(0)
-data = {"x": rng.rand(256, 8).astype(np.float32),
-        "y": rng.rand(256).astype(np.float32)}
-
-def run(cfg, **kw):
-    est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
-                       config={"steps_per_dispatch": 1, **cfg}, **kw)
-    stats = est.fit(dict(data), epochs=1, batch_size=32, verbose=False)
-    return [s["train_loss"] for s in stats], est
-
-lf, _ = run({"comms_plane": True})
-lb, est = run({"grad_bucket_mb": 4.0}, sharded_update=True)
-snap = est.data_pipeline_stats()["comms"]
-keys = ("buckets", "collectives_per_step", "wire_bytes_per_step",
-        "grad_leaves", "sharded_update", "wire_dtype", "opt_shard_elems")
-out = {k: snap[k] for k in keys if k in snap}
-out["bit_identical_to_flat"] = lf == lb
-print("COMMS_PLANE=" + json.dumps(out))
-EOF
-# resilience-plane snapshot: one injected mid-fit fault through the
-# training supervisor + a shed/breaker pass through the serving engine
-# (never affects the exit code)
-env JAX_PLATFORMS=cpu python - <<'EOF' 2>/dev/null || true
-import json
-import tempfile
-import time
-import numpy as np
-import flax.linen as nn
-from analytics_zoo_tpu import init_orca_context
-from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
-from analytics_zoo_tpu.resilience import TrainingSupervisor, faults
-from analytics_zoo_tpu.serving import ClusterServing, InMemoryBroker
-from analytics_zoo_tpu.serving.codecs import encode_payload
-
-init_orca_context("local")
-
-class M(nn.Module):
-    @nn.compact
-    def __call__(self, x):
-        return nn.Dense(1)(x)[:, 0]
-
-rng = np.random.RandomState(0)
-data = {"x": rng.rand(64, 8).astype(np.float32),
-        "y": rng.rand(64).astype(np.float32)}
-with tempfile.TemporaryDirectory() as d:
-    sup = TrainingSupervisor(
-        lambda: TPUEstimator(M(), loss="mse", optimizer="adam",
-                             model_dir=d, seed=0,
-                             config={"steps_per_dispatch": 1}),
-        model_dir=d, max_restarts=2)
-    sup.retry_policy.base_delay_s = 0.05
-    with faults.inject("engine.dispatch", count=1, skip=3):
-        report = sup.fit(dict(data), epochs=2, batch_size=32)
-    sup.estimator.shutdown()
-
-class _Echo:
-    def predict(self, x):
-        return np.asarray(x)
-
-broker = InMemoryBroker()
-cs = ClusterServing(_Echo(), queue=broker, batch_size=4)
-for i in range(2):
-    broker.enqueue(f"x{i}", encode_payload(
-        np.ones(2, np.float32), meta={"deadline": time.time() - 1}))
-for i in range(2):
-    broker.enqueue(f"l{i}", encode_payload(
-        np.ones(2, np.float32), meta={"deadline": time.time() + 30}))
-cs.start()
-for i in range(2):
-    broker.get_result(f"l{i}", 10.0)
-    broker.get_result(f"x{i}", 10.0)
-res = cs.metrics()["resilience"]
-cs.drain(timeout_s=10.0)
-print("RESILIENCE=" + json.dumps({
-    "restarts": report["restarts"], "hangs": report["hangs"],
-    "crashes": report["crashes"],
-    "steps_replayed": report["steps_replayed"],
-    "downtime_s": round(report["downtime_s"], 3),
-    "bit_exact_resume": report["completed"],
-    "shed_expired": res["shed_expired"],
-    "shed_open": res["shed_open"],
-    "breaker_state": res["breaker"]["state"]}))
-EOF
-# analysis-plane snapshot: repo lint findings, golden program-contract
-# drift, and the HLO linter's hook report from a bucketed comms fit on the
-# 8-device simulated mesh (never affects the exit code)
-env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python - <<'EOF' 2>/dev/null || true
-import json
-import numpy as np
-import flax.linen as nn
-from analytics_zoo_tpu import init_orca_context
-from analytics_zoo_tpu.analysis import golden, repolint
-from analytics_zoo_tpu.analysis.hlo_lint import lint_report
-from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
-
-init_orca_context("cpu-sim", mesh_axes={"dp": -1})
-
-repo_findings = repolint.lint_paths(repolint.repo_roots())
-golden_ok, golden_delta = golden.check()
-
-class M(nn.Module):
-    @nn.compact
-    def __call__(self, x):
-        x = nn.relu(nn.Dense(32)(x))
-        return nn.Dense(1)(x)[:, 0]
-
-rng = np.random.RandomState(0)
-est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
-                   sharded_update=True,
-                   config={"steps_per_dispatch": 1, "grad_bucket_mb": 4.0})
-est.fit({"x": rng.rand(128, 8).astype(np.float32),
-         "y": rng.rand(128).astype(np.float32)},
-        epochs=1, batch_size=32, verbose=False)
-hlo = lint_report()
-print("ANALYSIS=" + json.dumps({
-    "repolint_rules": list(repolint.RULES),
-    "repolint_findings": len(repo_findings),
-    "golden_drift": len(golden_delta),
-    "hlo_programs_linted": hlo["programs_linted"],
-    "hlo_findings": hlo["by_rule"],
-    "comms_accounting_verified": hlo["comms_verified"]}))
-EOF
+# per-plane snapshot lines (TRANSFER_PLANE= / CKPT_PLANE= / COMMS_PLANE= /
+# RESILIENCE= / ANALYSIS= / OBS=): tiny CPU workloads through each plane's
+# production path, all through the ONE zoo-metrics snapshot codepath
+# (analytics_zoo_tpu/obs/snapshots.py — previously five bespoke heredocs
+# here). One process per plane: the comms/analysis snapshots configure the
+# 8-device simulated mesh themselves, which must happen before the JAX
+# backend first initializes. Never affects the exit code.
+for plane in transfer ckpt comms resilience analysis obs; do
+    env JAX_PLATFORMS=cpu \
+        python -m analytics_zoo_tpu.obs snapshot "$plane" \
+        2>/dev/null | grep -aE '^[A-Z_]+=' || true
+done
 exit $rc
