@@ -11,12 +11,19 @@ happening, an ``extra_key`` change that collapses two layouts onto one
 executable) fails the gate with a readable delta instead of surfacing as
 a bench slowdown five PRs later.
 
-Four legs mirror ``bench.py bench_comms`` on the 8-device simulated mesh:
+Five legs mirror ``bench.py bench_comms`` on the 8-device simulated mesh:
 
 * ``baseline``          — comms plane off (the pre-plane GSPMD step)
 * ``flat``              — plane on, flat per-leaf-psum reference wire
 * ``bucketed_sharded``  — 4 MiB buckets + ZeRO-1 sharded update
 * ``bucketed_bf16``     — 4 MiB buckets, bf16 collective wire
+* ``overlapped``        — multi-bucket overlapped backward–comms pipeline
+  (PR 11): per-bucket reduce-scatters assembled from their own leaf
+  slices + ZeRO-1. Its contract additionally pins
+  ``overlapped_wire_matches_bucketed`` — the total reduce-scatter wire
+  bytes must stay byte-for-byte what the bucketed leg moves (the padded
+  total is invariant to the bucket split), so overlap can never trade
+  launch position for extra bytes unnoticed.
 
 Regenerate after an *intentional* program change::
 
@@ -42,12 +49,18 @@ __all__ = ["capture_contracts", "check", "diff_contracts", "golden_path",
 GOLDEN_FILE = "program_contracts.json"
 
 # contract legs: name -> (estimator config, estimator kwargs)
+# overlapped uses SMALL buckets on purpose: a multi-bucket layout is the
+# shape the pipeline exists for (one bucket = nothing to overlap), and for
+# the f32 wire the padded total — hence wire bytes — is invariant to the
+# bucket split, which the overlapped_wire_matches_bucketed field pins.
 _LEGS = [
     ("baseline", {}, {}),
     ("flat", {"comms_plane": True}, {}),
     ("bucketed_sharded", {"grad_bucket_mb": 4.0}, {"sharded_update": True}),
     ("bucketed_bf16", {"grad_bucket_mb": 4.0, "allreduce_dtype": "bf16"},
      {}),
+    ("overlapped", {"grad_bucket_mb": 0.001, "comms_overlap": True},
+     {"sharded_update": True}),
 ]
 
 
@@ -151,7 +164,7 @@ def capture_contracts() -> Dict[str, Any]:
         if declared is not None:
             keep = ("buckets", "collectives_per_step", "wire_bytes_per_step",
                     "grad_leaves", "sharded_update", "wire_dtype",
-                    "grad_bytes_f32")
+                    "grad_bytes_f32", "overlap", "segments")
             entry["declared"] = {k: declared[k] for k in keep
                                  if k in declared}
             # the accounting rule run right here: measured bytes/launches
@@ -166,6 +179,13 @@ def capture_contracts() -> Dict[str, Any]:
     # comms fingerprint / extra_key salting collapses this number
     contracts["distinct_train_executables"] = (
         len(set(train_keys)) if train_keys else None)
+    # the overlapped pipeline's wire contract: launching per-bucket out of
+    # leaf-sliced segments must move EXACTLY the bytes the bucketed leg
+    # moves — drift here means overlap changed the wire, not the schedule
+    if "overlapped" in contracts and "bucketed_sharded" in contracts:
+        contracts["overlapped_wire_matches_bucketed"] = (
+            contracts["overlapped"]["rs_wire_bytes"]
+            == contracts["bucketed_sharded"]["rs_wire_bytes"])
     return contracts
 
 

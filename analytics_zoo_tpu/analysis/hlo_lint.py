@@ -64,6 +64,16 @@ _TENSOR_RE = re.compile(r"tensor<([0-9x]*?)((?:f|bf|i|u|c)\d+)>")
 _COLLECTIVE_RE = re.compile(
     r"\"?stablehlo\.(all_reduce|reduce_scatter|all_gather|all_to_all|"
     r"collective_permute)\"?\(")
+# async collective start/done pairs — what XLA's latency-hiding scheduler
+# emits when a collective overlaps compute (HLO `reduce-scatter-start` /
+# `-done`, mhlo/stablehlo `_start`/`_done` forms). One start+done pair is
+# ONE launch on the wire: starts count under the base kind, dones are
+# skipped — otherwise an overlapped program double-counts every collective
+# against the declared accounting.
+_ASYNC_COLLECTIVE_RE = re.compile(
+    r"[\"% ]\s*(?:stablehlo\.|mhlo\.)?"
+    r"(all[-_]reduce|reduce[-_]scatter|all[-_]gather|all[-_]to[-_]all|"
+    r"collective[-_]permute)[-_](start|done)\"?\(")
 _CONVERT_RE = re.compile(
     r"stablehlo\.convert\s.*:\s*\(tensor<([0-9x]*?)((?:f|bf|i|u|c)\d+)>\)"
     r"\s*->\s*tensor<[0-9x]*?((?:f|bf|i|u|c)\d+)>")
@@ -111,15 +121,21 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
     """Collective ops in a StableHLO module, with operand/result byte
     sizes taken from their type signatures. Ops with a reduction region
     (all_reduce, reduce_scatter) carry the signature on the region-closing
-    ``}) : (...) -> ...`` line; region-free ops carry it inline."""
+    ``}) : (...) -> ...`` line; region-free ops carry it inline.
+
+    Async start/done-style collectives (an overlapped program's
+    ``reduce-scatter-start`` / ``-done`` pairs) count as ONE launch of the
+    base kind: the ``start`` carries the wire operand and is recorded, the
+    matching ``done`` is skipped."""
     out = []
     lines = text.splitlines()
-    for i, line in enumerate(lines):
-        m = _COLLECTIVE_RE.search(line)
-        if not m:
-            continue
-        sig_line = line
-        if _SIG_RE.search(line) is None:
+
+    def _signature(i: int):
+        """The op's type signature — on its own line, or (for ops carrying
+        a reduction region, sync AND async-start forms alike) on the
+        region-closing ``}) : (...) -> ...`` line further down."""
+        sig_line = lines[i]
+        if _SIG_RE.search(sig_line) is None:
             for j in range(i + 1, min(i + 40, len(lines))):
                 if "}) :" in lines[j] or "}> :" in lines[j]:
                     sig_line = lines[j]
@@ -127,8 +143,24 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
         sig = _SIG_RE.search(sig_line)
         operand = _tensor_bytes(sig.group(1)) if sig else 0
         after = sig_line[sig.end():] if sig else ""
+        return operand, _tensor_bytes(after)
+
+    for i, line in enumerate(lines):
+        m = _ASYNC_COLLECTIVE_RE.search(line)
+        if m is not None:
+            if m.group(2) == "done":
+                continue                      # the pair's start was counted
+            operand, result = _signature(i)
+            out.append(CollectiveOp(kind=m.group(1).replace("-", "_"),
+                                    operand_bytes=operand,
+                                    result_bytes=result))
+            continue
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        operand, result = _signature(i)
         out.append(CollectiveOp(kind=m.group(1), operand_bytes=operand,
-                                result_bytes=_tensor_bytes(after)))
+                                result_bytes=result))
     return out
 
 

@@ -75,6 +75,14 @@ _KNOBS = [
        "(block-scaled, simulated wire)."),
     _k("ZOO_ALLREDUCE_BLOCK", "int", 256, "comms",
        "Elements per int8 quantization scale block."),
+    _k("ZOO_COMMS_OVERLAP", "bool", False, "comms",
+       "Overlapped backward-comms pipeline: assemble each gradient bucket "
+       "from its own leaf slices so its reduce-scatter launches as soon "
+       "as those grads exist, hiding wire time behind backward compute."),
+    _k("ZOO_COMMS_SEGMENTS", "int", 0, "comms",
+       "Dependency-island override for the overlapped pipeline: 0 = one "
+       "segment per bucket (max overlap), 1 = classic post-backward wire, "
+       "N = buckets coalesced into N contiguous groups."),
     _k("ZOO_EMBED_GRAD_MODE", "str", "auto", "comms",
        "Embedding gradient exchange: auto | dense | sparse."),
     # --- checkpoint plane ---------------------------------------------------

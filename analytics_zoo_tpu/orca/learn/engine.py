@@ -522,17 +522,39 @@ class TrainEngine:
     def _comms_bucketed_update(self, plan, params, opt_state, resid, grads):
         """Bucketed reduce-scatter (+ quantized wire + error feedback),
         then either the ZeRO-1 sharded update + param all-gather, or the
-        classic replicated update off the all-gathered mean grads."""
+        classic replicated update off the all-gathered mean grads.
+
+        Overlapped mode (``plan.segplan``) assembles each bucket straight
+        from its own leaf slices instead of slicing one whole-tree flat
+        vector: same elements, same order, bit-identical — but bucket k's
+        reduce-scatter then depends only on the leaves composing it, so
+        the collective is schedulable as soon as reverse AD produced
+        those gradients, while later segments' backward keeps computing
+        (the Horovod tensor-fusion pipeline, in the XLA dependence
+        graph). The whole-tree ``flatten`` below is the barrier overlap
+        removes."""
         from ...parallel import collective as C
         n = plan.layout.n_dev
-        flat = plan.layout.flatten(grads)
+        if plan.segplan is not None:
+            bucket_vals = plan.segplan.bucket_values(grads)
+            if resid is not None:
+                # per-bucket residual add keeps each bucket's dependence
+                # cone its own (resid is a step input, not a barrier)
+                bucket_vals = [b + r for b, r in zip(
+                    bucket_vals, plan.layout.buckets(resid[0]))]
+        else:
+            flat = plan.layout.flatten(grads)
+            if resid is not None:
+                # error feedback: add back what last step's quantized wire
+                # dropped, and carry forward what this step's drops
+                flat = flat + resid[0]
+            bucket_vals = plan.layout.buckets(flat)
+        shards, wires = plan.reduce_scatter_bucket_list(bucket_vals)
         if resid is not None:
-            # error feedback: add back what last step's quantized wire
-            # dropped, and carry forward what this step's drops
-            flat = flat + resid[0]
-        shards, wires = plan.reduce_scatter_buckets(flat)
-        if resid is not None:
-            new_resid = (flat - jnp.concatenate(wires))[None]
+            # elementwise subtract commutes with the bucket split, so the
+            # per-bucket form is bit-identical to (flat - concat(wires))
+            new_resid = jnp.concatenate(
+                [b - w for b, w in zip(bucket_vals, wires)])[None]
         else:
             new_resid = resid
         scale = self._comms_clip_scale(shards)
@@ -741,6 +763,39 @@ class TrainEngine:
         return fn.cache_key(self.params, self.extra_vars, metric_states,
                             batch.x, batch.y, batch.w)
 
+    def _record_comms_spans(self, t0: float, t1: float,
+                            parent: Optional[str], steps: int = 1):
+        """Per-bucket ``comms.rs_start`` / ``comms.rs_done`` span markers
+        on the step timeline (overlapped mode, tracing armed).
+
+        The reduce-scatters launch INSIDE one fused XLA program, so their
+        per-bucket device timing is not host-observable; what the host
+        does know is the measured dispatch window and the static plan
+        (bucket count, wire bytes, segment order). The markers place each
+        bucket's launch/completion across the window in plan order,
+        carrying the declared byte accounting as attrs — enough for the
+        Perfetto timeline to attribute which slice of the step is wire
+        time and which bucket it belongs to (``modeled: true`` says the
+        sub-step placement is derived, not sampled)."""
+        plan = self.comms
+        lo = plan.layout
+        n_b = len(lo.bucket_sizes)
+        window = (t1 - t0) / max(steps, 1)
+        per_bucket_bytes = lo.wire_bytes_per_step() / n_b
+        for s in range(min(steps, 8)):      # cap fused attribution depth
+            base = t0 + s * window
+            for k in range(n_b):
+                ts = base + window * k / n_b
+                te = base + window * (k + 1) / n_b
+                _trace.record_span("comms.rs_start", ts, ts, parent=parent,
+                                   bucket=k, step=self.step + s,
+                                   wire_bytes=int(per_bucket_bytes),
+                                   segments=plan.segplan.n_segments,
+                                   modeled=True)
+                _trace.record_span("comms.rs_done", te, te, parent=parent,
+                                   bucket=k, step=self.step + s,
+                                   modeled=True)
+
     def train_batch(self, batch: Batch) -> jnp.ndarray:
         self.ensure_jit_train()
         # resilience hooks (one global read each when disarmed): the
@@ -749,6 +804,7 @@ class TrainEngine:
         wd = _watchdog.active()
         token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
+        tok = None
         try:
             # obs span (one flag check disarmed): the per-step device-time
             # segment the Perfetto timeline renders, step-indexed
@@ -762,11 +818,16 @@ class TrainEngine:
                 else:
                     self.params, self.extra_vars, self.opt_state, loss = \
                         self._jit_train(*self.train_step_args(batch))
+                tok = _trace.token()
         finally:
             if token is not None:
                 wd.exit(token)
+        t1 = time.perf_counter()
+        if (self.comms is not None and self.comms.segplan is not None
+                and _trace.enabled()):
+            self._record_comms_spans(t0, t1, tok)
         if self.pipeline_stats is not None:
-            self.pipeline_stats.add("step", time.perf_counter() - t0)
+            self.pipeline_stats.add("step", t1 - t0)
         self.step += 1
         return loss
 
@@ -788,6 +849,7 @@ class TrainEngine:
         wd = _watchdog.active()
         token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
+        tok = None
         try:
             with _trace.span("engine.dispatch", step=self.step,
                              fused=int(batch.fused)):
@@ -799,14 +861,18 @@ class TrainEngine:
                 else:
                     self.params, self.extra_vars, self.opt_state, losses = \
                         self._jit_train_multi(*self.train_step_args(batch))
+                tok = _trace.token()
         finally:
             if token is not None:
                 wd.exit(token)
+        t1 = time.perf_counter()
         k = int(losses.shape[0])
         if self.comms is not None:
             self.comms_steps += k
+            if self.comms.segplan is not None and _trace.enabled():
+                self._record_comms_spans(t0, t1, tok, steps=k)
         if self.pipeline_stats is not None:
-            self.pipeline_stats.add("step", time.perf_counter() - t0,
+            self.pipeline_stats.add("step", t1 - t0,
                                     count=k)
         self.step += k
         return losses

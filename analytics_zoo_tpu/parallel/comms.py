@@ -30,6 +30,28 @@ tunable plane instead of whatever GSPMD happens to emit:
   no int8-accumulating allreduce) — byte accounting reports what a native
   int8 wire would move.
 
+* **Overlapped backward–comms pipeline** (``ZOO_COMMS_OVERLAP``) — the
+  bucketed wire above still assembles ONE padded flat vector from every
+  grad leaf before the first reduce-scatter can launch: that concatenate
+  is a synchronization barrier, so wire time adds to — instead of hides
+  behind — backward compute (Horovod's tensor-fusion lesson,
+  arXiv:1802.05799). In overlapped mode a :class:`SegmentPlan` stages the
+  gradient wire into bucket-aligned segments assembled straight from the
+  leaf slices that compose each bucket, so bucket k's reduce-scatter
+  depends only on its own leaves' gradients — the moment reverse AD has
+  produced them, the collective is schedulable while later segments (the
+  earlier layers' backward) keep computing. XLA's latency-hiding
+  scheduler needs exactly that dependence freedom to issue the async
+  start early and sink the done; on the CPU-sim mesh the program is
+  sequential, so the win is asserted structurally (per-bucket dependency
+  cones, launch counts, byte-identical wire) and measured on hardware.
+  ``ZOO_COMMS_SEGMENTS`` coarsens the pipeline: buckets grouped into N
+  dependency islands (1 = the classic post-backward wire, the default 0 =
+  one segment per bucket = maximum overlap). Values on the wire are the
+  exact same elements in the exact same order as the flat-vector path, so
+  the plane's bit-identity contract extends: flat == bucketed == sharded
+  == overlapped, and total wire bytes are byte-for-byte unchanged.
+
 Numerics contract (asserted by tests/test_comms_plane.py): within the comms
 plane, bucketed == flat-psum bit-exactly and sharded == unsharded bit-exactly
 on an f32 mesh. The plane itself is *opt-in*: with it off, the engine's
@@ -54,7 +76,8 @@ from jax import lax
 
 from . import collective as C
 
-__all__ = ["CommsConfig", "BucketLayout", "CommsPlan", "build_layout"]
+__all__ = ["CommsConfig", "BucketLayout", "CommsPlan", "SegmentPlan",
+           "build_layout"]
 
 WIRE_DTYPES = ("f32", "bf16", "int8")
 _WIRE_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
@@ -83,6 +106,16 @@ class CommsConfig:
                    flat-psum reference wire, one collective per grad leaf.
                    This is the baseline bench_comms compares buckets
                    against.
+    overlap      — overlapped backward–comms pipeline (``ZOO_COMMS_OVERLAP``
+                   / config ``comms_overlap``): assemble each bucket
+                   straight from its own leaf slices so its reduce-scatter
+                   launches as soon as those gradients exist, instead of
+                   behind a whole-tree flatten barrier.
+    segments     — dependency-island override for the overlapped pipeline
+                   (``ZOO_COMMS_SEGMENTS`` / config ``comms_segments``):
+                   0 = one segment per bucket (maximum overlap), 1 = a
+                   single segment (the classic post-backward wire shape),
+                   N = buckets coalesced into N contiguous groups.
     """
 
     bucket_mb: float = 0.0
@@ -91,6 +124,8 @@ class CommsConfig:
     block: int = 256
     axis: str = "dp"
     explicit: bool = False
+    overlap: bool = False
+    segments: int = 0
 
     DEFAULT_BUCKET_MB = 4.0
 
@@ -103,11 +138,14 @@ class CommsConfig:
             raise ValueError("grad_bucket_mb must be >= 0")
         if self.block < 1:
             raise ValueError("allreduce block must be >= 1")
+        if self.segments < 0:
+            raise ValueError("comms_segments must be >= 0")
 
     @property
     def active(self) -> bool:
         return (self.sharded_update or self.bucket_mb > 0
-                or self.wire_dtype != "f32" or self.explicit)
+                or self.wire_dtype != "f32" or self.explicit
+                or self.overlap)
 
     @property
     def quantized(self) -> bool:
@@ -119,17 +157,21 @@ class CommsConfig:
         unset bucket size resolves to the default when either is on."""
         if self.bucket_mb > 0:
             return self.bucket_mb
-        if self.sharded_update or self.quantized:
+        if self.sharded_update or self.quantized or self.overlap:
             return self.DEFAULT_BUCKET_MB
         return 0.0
 
     def fingerprint(self) -> str:
         """Stable string for the compile plane's structural key — two
-        engines whose comms knobs differ must never share an executable."""
+        engines whose comms knobs differ must never share an executable.
+        The overlap flag and segment override are program shape (where the
+        reduce-scatters sit in the dependence graph), so they salt the key
+        exactly like the bucket layout does."""
         return (f"comms:bucket_mb={self.effective_bucket_mb}:"
                 f"sharded={int(self.sharded_update)}:"
                 f"wire={self.wire_dtype}:block={self.block}:"
-                f"axis={self.axis}")
+                f"axis={self.axis}:overlap={int(self.overlap)}:"
+                f"segments={self.segments}")
 
     @classmethod
     def resolve(cls, config: Optional[Dict] = None,
@@ -156,8 +198,14 @@ class CommsConfig:
         raw_exp = cfg.get("comms_plane", _env("ZOO_COMMS_PLANE"))
         explicit = str(raw_exp).lower() in ("1", "true", "yes", "on") \
             if raw_exp is not None else False
+        raw_ov = cfg.get("comms_overlap", _env("ZOO_COMMS_OVERLAP"))
+        overlap = str(raw_ov).lower() in ("1", "true", "yes", "on") \
+            if raw_ov is not None else False
+        segments = int(cfg.get("comms_segments",
+                               _env("ZOO_COMMS_SEGMENTS", 0)))
         return cls(bucket_mb=bucket_mb, sharded_update=bool(sharded_update),
-                   wire_dtype=wire, block=block, explicit=explicit)
+                   wire_dtype=wire, block=block, explicit=explicit,
+                   overlap=overlap, segments=segments)
 
 
 # ---------------------------------------------------------------------------
@@ -350,6 +398,127 @@ def build_layout(tree, n_dev: int, cfg: CommsConfig) -> BucketLayout:
 
 
 # ---------------------------------------------------------------------------
+# segment plan — the overlapped pipeline's dependence structure
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class LeafPiece:
+    """One contiguous run of a leaf's flattened elements inside a bucket."""
+
+    leaf: int       # index into the layout's tree_flatten leaf order
+    start: int      # first element of the leaf (flat view) in this piece
+    stop: int       # one past the last element
+
+
+@dataclass(frozen=True)
+class SegmentPlan:
+    """Bucket-aligned staging of the gradient wire for the overlapped
+    backward–comms pipeline.
+
+    The classic bucketed path pads-and-concatenates EVERY grad leaf into
+    one flat vector and slices buckets out of it — so in the lowered
+    program every bucket's reduce-scatter transitively depends on every
+    leaf, and no collective can issue until the whole backward pass has
+    finished. This plan records, per bucket, exactly which leaf slices
+    compose it (:class:`LeafPiece` runs, plus trailing zero padding on the
+    final bucket only), and groups buckets into contiguous *segments* —
+    independent dependency islands. :meth:`bucket_values` assembles each
+    segment straight from its own leaves, so bucket k's reduce-scatter is
+    schedulable the moment reverse AD has produced leaves
+    ``pieces[k]`` — while the remaining segments' backward still runs.
+
+    Element order inside every bucket is identical to
+    ``layout.buckets(layout.flatten(tree))`` — same values, same order,
+    bit for bit — only the dependence structure changes. ``n_segments``:
+    0 = one segment per bucket (maximum overlap, the default), 1 = one
+    segment spanning everything (the classic post-backward shape), N =
+    buckets coalesced into N contiguous groups.
+    """
+
+    bucket_pieces: Tuple[Tuple[LeafPiece, ...], ...]
+    bucket_pad: Tuple[int, ...]          # trailing zeros per bucket
+    segments: Tuple[Tuple[int, ...], ...]  # bucket indices per segment
+    bucket_sizes: Tuple[int, ...]
+
+    @staticmethod
+    def build(layout: "BucketLayout",
+              n_segments: int = 0) -> "SegmentPlan":
+        pieces: List[Tuple[LeafPiece, ...]] = []
+        pads: List[int] = []
+        leaf, off = 0, 0                 # cursor into the flat leaf order
+        for b in layout.bucket_sizes:
+            need, got = b, []
+            while need > 0 and leaf < len(layout.sizes):
+                take = min(need, layout.sizes[leaf] - off)
+                got.append(LeafPiece(leaf, off, off + take))
+                off += take
+                need -= take
+                if off == layout.sizes[leaf]:
+                    leaf, off = leaf + 1, 0
+            pieces.append(tuple(got))
+            pads.append(need)            # only the tail bucket pads
+        if n_segments <= 0 or n_segments >= len(layout.bucket_sizes):
+            groups = tuple((k,) for k in range(len(layout.bucket_sizes)))
+        else:
+            # contiguous groups, balanced by bucket count (bucket sizes are
+            # already uniform apart from the tail)
+            n_b = len(layout.bucket_sizes)
+            bounds = [round(i * n_b / n_segments)
+                      for i in range(n_segments + 1)]
+            groups = tuple(tuple(range(lo, hi))
+                           for lo, hi in zip(bounds, bounds[1:]) if hi > lo)
+        return SegmentPlan(bucket_pieces=tuple(pieces),
+                           bucket_pad=tuple(pads), segments=groups,
+                           bucket_sizes=tuple(layout.bucket_sizes))
+
+    @property
+    def n_segments(self) -> int:
+        return len(self.segments)
+
+    def _assemble(self, leaves: List, seg: Tuple[int, ...], np_mod):
+        """Concatenate one segment's leaf pieces (+ tail padding)."""
+        parts = []
+        for k in seg:
+            for p in self.bucket_pieces[k]:
+                flat = leaves[p.leaf].reshape(-1)
+                parts.append(flat[p.start:p.stop])
+            if self.bucket_pad[k]:
+                parts.append(np_mod.zeros((self.bucket_pad[k],),
+                                          np_mod.float32))
+        return parts[0] if len(parts) == 1 else np_mod.concatenate(parts)
+
+    def bucket_values(self, grads) -> List:
+        """Grad pytree -> per-bucket f32 vectors, assembled segment-wise so
+        each bucket's dependence cone is exactly its own leaves. Bit-exact
+        to ``layout.buckets(layout.flatten(grads))``."""
+        leaves = [l.reshape(-1).astype(jnp.float32)
+                  for l in jax.tree_util.tree_leaves(grads)]
+        out: List = [None] * len(self.bucket_sizes)
+        for seg in self.segments:
+            seg_flat = self._assemble(leaves, seg, jnp)
+            if len(seg) == 1:
+                out[seg[0]] = seg_flat
+            else:
+                o = 0
+                for k in seg:
+                    out[k] = seg_flat[o:o + self.bucket_sizes[k]]
+                    o += self.bucket_sizes[k]
+        return out
+
+    def bucket_values_np(self, grads) -> List[np.ndarray]:
+        """Numpy host twin of :meth:`bucket_values` (tests, tooling)."""
+        leaves = [np.asarray(l).reshape(-1).astype(np.float32)
+                  for l in jax.tree_util.tree_leaves(grads)]
+        out: List[np.ndarray] = [None] * len(self.bucket_sizes)
+        for seg in self.segments:
+            seg_flat = np.asarray(self._assemble(leaves, seg, np))
+            o = 0
+            for k in seg:
+                out[k] = seg_flat[o:o + self.bucket_sizes[k]]
+                o += self.bucket_sizes[k]
+        return out
+
+
+# ---------------------------------------------------------------------------
 # quantized wire
 # ---------------------------------------------------------------------------
 def quantize_wire(x, wire_dtype: str, block: int):
@@ -386,6 +555,11 @@ class CommsPlan:
         self.cfg = cfg
         self.layout = layout
         self.axis = cfg.axis
+        # overlapped pipeline: the bucket-aligned segment plan that lets
+        # each bucket's reduce-scatter depend only on its own leaves
+        self.segplan: Optional[SegmentPlan] = (
+            SegmentPlan.build(layout, cfg.segments) if cfg.overlap
+            else None)
 
     # -- telemetry -----------------------------------------------------------
     def summary(self) -> Dict[str, Any]:
@@ -410,6 +584,8 @@ class CommsPlan:
             "grad_bytes_f32": lo.grad_bytes_f32(),
             "opt_shard_elems": lo.shard_size,
             "opt_full_elems": lo.padded_total,
+            "overlap": cfg.overlap,
+            "segments": self.segplan.n_segments if self.segplan else 0,
         }
 
     # -- in-step collectives (per-replica view) ------------------------------
@@ -417,16 +593,20 @@ class CommsPlan:
         """Flat-psum reference wire: one pmean per grad leaf."""
         return jax.tree.map(lambda g: lax.pmean(g, self.axis), grads)
 
-    def reduce_scatter_buckets(self, flat_with_resid):
-        """Quantize (optional) + reduce-scatter every bucket. Returns
-        (list of per-bucket summed f32 shards, list of f32 wire values as
-        the receiver reconstructs them) — the wire values feed the
-        caller's error-feedback residual.
+    def reduce_scatter_bucket_list(self, bucket_vals):
+        """Quantize (optional) + reduce-scatter every bucket of an
+        already assembled bucket list. Returns (list of per-bucket summed
+        f32 shards, list of f32 wire values as the receiver reconstructs
+        them) — the wire values feed the caller's error-feedback
+        residual. The caller chooses the assembly: ``layout.buckets``
+        slices of the whole-tree flat vector (classic), or
+        :meth:`SegmentPlan.bucket_values` (overlapped — each launch keeps
+        its own dependence cone).
 
         bf16 REALLY rides the collective: the reduce-scatter operand is
         bf16, so each element moves 2 bytes on ICI/DCN. Note the EF
         residual feeds back only this replica's LOCAL f32->bf16 cast
-        error (``flat - wire``); rounding introduced inside the bf16
+        error (``bucket - wire``); rounding introduced inside the bf16
         reduction's accumulation is not observable per replica and is NOT
         corrected — at large dp degrees, where accumulation error can
         dominate cast error, expect drift beyond the cast-error bound.
@@ -434,7 +614,7 @@ class CommsPlan:
         dequantized before an f32 reduce and only the byte accounting
         reflects the native int8 cost."""
         shards, wires = [], []
-        for bucket in self.layout.buckets(flat_with_resid):
+        for bucket in bucket_vals:
             if self.cfg.wire_dtype == "bf16":
                 wire16 = bucket.astype(jnp.bfloat16)
                 shards.append(C.reduce_scatter(wire16, self.axis)

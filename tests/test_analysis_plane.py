@@ -129,6 +129,47 @@ def test_parse_collectives_reads_region_and_inline_signatures():
     assert ag.operand_bytes == 105 * 4 and ag.result_bytes == 840 * 4
 
 
+_ASYNC_MODULE = textwrap.dedent("""\
+    module @jit_step_async {
+      func.func public @main(%arg0: tensor<840xf32>) -> tensor<840xf32> {
+        %0 = "stablehlo.reduce_scatter_start"(%arg0) <{scatter_dimension = 0 : i64}> ({
+        ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+          %s = stablehlo.add %a, %b : tensor<f32>
+          stablehlo.return %s : tensor<f32>
+        }) : (tensor<840xf32>) -> tensor<105xf32>
+        %1 = "stablehlo.reduce_scatter_done"(%0) : (tensor<105xf32>) -> tensor<105xf32>
+        %2 = "stablehlo.all_gather_start"(%1) : (tensor<105xf32>) -> tensor<840xf32>
+        %3 = "stablehlo.all_gather_done"(%2) : (tensor<840xf32>) -> tensor<840xf32>
+        return %3 : tensor<840xf32>
+      }
+    }
+    """)
+
+
+def test_parse_collectives_counts_async_start_done_pairs_once():
+    """Start/done-style async collectives (what XLA's latency-hiding
+    scheduler emits for an overlapped program, PR 11) are ONE launch per
+    pair: the start carries the wire operand — including when it carries
+    a reduction REGION, where the signature sits on the region-closing
+    line (how reduce_scatter_start actually prints) — and the done is
+    skipped; double-counting would fail every overlapped program's
+    accounting."""
+    ops = parse_collectives(_ASYNC_MODULE)
+    kinds = [op.kind for op in ops]
+    assert sorted(kinds) == ["all_gather", "reduce_scatter"]
+    rs = next(op for op in ops if op.kind == "reduce_scatter")
+    assert rs.operand_bytes == 840 * 4 and rs.result_bytes == 105 * 4
+    # HLO-text style (hyphenated) counts the same way, launches only
+    hlo = ("%rs = f32[105] reduce-scatter-start(%p)\n"
+           "%rsd = f32[105] reduce-scatter-done(%rs)\n")
+    assert [op.kind for op in parse_collectives(hlo)] == ["reduce_scatter"]
+    # and the accounting rule accepts an async pair as the declared bucket
+    declared = {"buckets": 1, "sharded_update": True, "wire_dtype": "f32",
+                "wire_bytes_per_step": 840 * 4}
+    assert HloLinter(target="cpu").lint_text(
+        _ASYNC_MODULE, label="train", declared=declared) == []
+
+
 def test_comms_accounting_rule_verifies_and_catches_drift():
     declared = {"buckets": 1, "sharded_update": True, "wire_dtype": "f32",
                 "wire_bytes_per_step": 840 * 4}
@@ -251,13 +292,36 @@ def test_golden_gate_fails_on_injected_collective_regression():
     tampered = json.loads(json.dumps(contracts))      # deep copy
     tampered["flat"]["collectives"]["all_reduce"] += 2
     tampered["bucketed_sharded"]["rs_wire_bytes"] *= 2
+    # an overlapped launch-count regression (a segment merge collapsing
+    # per-bucket reduce-scatters into one) must fail field-level too
+    tampered["overlapped"]["collectives"]["reduce_scatter"] = 1
+    tampered["overlapped_wire_matches_bucketed"] = False
     ok, delta = golden_mod.check(measured=tampered)
     assert not ok
     joined = "\n".join(delta)
     assert "flat.collectives.all_reduce" in joined
     assert "bucketed_sharded.rs_wire_bytes" in joined
+    assert "overlapped.collectives.reduce_scatter" in joined
+    assert "overlapped_wire_matches_bucketed" in joined
     # the delta is field-level and readable: golden -> measured
     assert any("->" in line for line in delta)
+
+
+def test_overlapped_golden_leg_contract():
+    """The committed overlapped contract: one reduce-scatter launch per
+    bucket (a real multi-bucket pipeline), total wire bytes byte-for-byte
+    the bucketed leg's, verified accounting, own executable."""
+    contracts = golden_mod.load_goldens()
+    leg = contracts["overlapped"]
+    assert leg["declared"]["overlap"] is True
+    assert leg["declared"]["buckets"] >= 2
+    assert leg["declared"]["segments"] == leg["declared"]["buckets"]
+    assert leg["collectives"]["reduce_scatter"] == leg["declared"]["buckets"]
+    assert leg["collectives"]["all_gather"] == 1      # ZeRO-1 param gather
+    assert leg["rs_wire_bytes"] == \
+        contracts["bucketed_sharded"]["rs_wire_bytes"]
+    assert contracts["overlapped_wire_matches_bucketed"] is True
+    assert leg["accounting_verified"] is True
 
 
 # ---------------------------------------------------------------------------

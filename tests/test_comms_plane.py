@@ -497,3 +497,192 @@ def test_comms_config_resolve_env(orca_context, monkeypatch):
     assert cfg2.wire_dtype == "f32" and cfg2.bucket_mb == 2.0
     with pytest.raises(ValueError):
         CommsConfig(wire_dtype="fp8")
+
+
+# ---------------------------------------------------------------------------
+# PR 11: overlapped backward-comms pipeline
+# ---------------------------------------------------------------------------
+def test_segment_plan_matches_flat_bucketing_bit_exact(orca_context):
+    """The overlapped pipeline's per-bucket assembly (each bucket built
+    straight from its own leaf slices) must produce the EXACT elements of
+    ``layout.buckets(layout.flatten(tree))`` — same values, same order —
+    for every segment grouping. Only the dependence structure changes."""
+    from analytics_zoo_tpu.parallel.comms import SegmentPlan
+
+    tree = _random_tree()
+    lo = build_layout(tree, 8, CommsConfig(bucket_mb=0.0005, overlap=True))
+    assert len(lo.bucket_sizes) > 1
+    ref = [np.asarray(b) for b in lo.buckets(lo.flatten(tree))]
+
+    for n_seg in (0, 1, 2, len(lo.bucket_sizes) + 5):
+        sp = SegmentPlan.build(lo, n_seg)
+        # every bucket is covered by pieces + padding, nothing overlaps
+        for k, b in enumerate(lo.bucket_sizes):
+            covered = sum(p.stop - p.start for p in sp.bucket_pieces[k])
+            assert covered + sp.bucket_pad[k] == b
+        assert sum(len(s) for s in sp.segments) == len(lo.bucket_sizes)
+        got = sp.bucket_values(tree)
+        got_np = sp.bucket_values_np(tree)
+        for r, g, gn in zip(ref, got, got_np):
+            assert (r == np.asarray(g)).all()
+            assert (r == gn).all()
+    # the default is maximum overlap: one segment per bucket
+    assert SegmentPlan.build(lo).n_segments == len(lo.bucket_sizes)
+    assert SegmentPlan.build(lo, 1).n_segments == 1
+    assert SegmentPlan.build(lo, 2).n_segments == 2
+
+
+def test_overlapped_bit_identical_to_flat_bucketed_sharded(orca_context):
+    """The full numerics contract, PR-11 edition: flat == bucketed ==
+    sharded == overlapped (+ overlapped sharded), all bit-identical on
+    the f32 mesh — the overlap only moves the reduce-scatters inside the
+    backward's dependence graph, never a value."""
+    lf, _ = _fit({"comms_plane": True})
+    lb, eb = _fit({"grad_bucket_mb": 0.001})
+    lo_, eo = _fit({"grad_bucket_mb": 0.001, "comms_overlap": True})
+    los, eos = _fit({"grad_bucket_mb": 0.001, "comms_overlap": True},
+                    sharded_update=True)
+    assert eo.engine.comms.segplan is not None
+    assert eo.engine.comms.segplan.n_segments == \
+        len(eo.engine.comms.layout.bucket_sizes) > 1
+    assert lf == lb == lo_ == los
+    wb = _flat_params(eb)
+    assert (wb == _flat_params(eo)).all()
+    assert (wb == _flat_params(eos)).all()
+    # wire accounting is byte-for-byte the bucketed leg's
+    sb = eb.data_pipeline_stats()["comms"]
+    so = eos.data_pipeline_stats()["comms"]
+    assert so["wire_bytes_per_step"] == sb["wire_bytes_per_step"]
+    assert so["overlap"] is True and sb["overlap"] is False
+    assert so["segments"] == so["buckets"]
+
+
+def test_overlapped_clipped_and_fused_variants_bit_identical(orca_context):
+    """Clip-norm (scale computed from the reduce-scattered shards) and the
+    scan-fused multi-step dispatch both ride the overlapped step without
+    moving a bit."""
+    def clipped(cfg, fuse=1, **kw):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": fuse, **cfg}, **kw)
+        est.set_l2_norm_gradient_clipping(0.05)
+        stats = est.fit(dict(_data()), epochs=2, batch_size=32,
+                        verbose=False)
+        return [s["train_loss"] for s in stats], _flat_params(est)
+
+    lb, wb = clipped({"grad_bucket_mb": 0.001}, sharded_update=True)
+    lo_, wo = clipped({"grad_bucket_mb": 0.001, "comms_overlap": True},
+                      sharded_update=True)
+    assert lb == lo_ and (wb == wo).all()
+    # scan-fused multi-step: k overlapped steps in one dispatch
+    l4, w4 = clipped({"grad_bucket_mb": 0.001, "comms_overlap": True},
+                     fuse=4, sharded_update=True)
+    assert l4 == lb and (w4 == wb).all()
+    # segment-count override regroups the pipeline without moving a bit
+    l2, w2 = clipped({"grad_bucket_mb": 0.001, "comms_overlap": True,
+                      "comms_segments": 2}, sharded_update=True)
+    assert l2 == lb and (w2 == wb).all()
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_overlapped_ef_residual_drift_bounded(orca_context, wire):
+    """The EF residual (quantized wire) rides the overlapped step: the
+    per-bucket residual add/subtract is bit-identical to the flat-vector
+    form, so overlapped+quantized == bucketed+quantized exactly, and the
+    drift vs the exact wire stays inside the PR-8 bounds over 50 steps."""
+    data = _data(n=128)
+    steps = 50
+    epochs = -(-steps * 32 // 128)
+    le, _ = _fit({"grad_bucket_mb": 0.001, "comms_overlap": True},
+                 epochs=epochs, data=data)
+    lq, eq = _fit({"grad_bucket_mb": 0.001, "allreduce_dtype": wire,
+                   "allreduce_block": 64, "comms_overlap": True},
+                  epochs=epochs, data=data)
+    lqb, eqb = _fit({"grad_bucket_mb": 0.001, "allreduce_dtype": wire,
+                     "allreduce_block": 64}, epochs=epochs, data=data)
+    # overlapped quantized == bucketed quantized, bit for bit (weights
+    # AND the carried residual)
+    assert lq == lqb
+    assert (_flat_params(eq) == _flat_params(eqb)).all()
+    assert (np.asarray(eq.engine.comms_resid)
+            == np.asarray(eqb.engine.comms_resid)).all()
+    # residual alive + drift vs the exact overlapped wire bounded
+    assert np.abs(np.asarray(eq.engine.comms_resid)).max() > 0
+    le, lq = np.asarray(le), np.asarray(lq)
+    assert np.all(np.abs(lq - le) <= 5e-3 * np.maximum(np.abs(le), 1e-3))
+    assert np.abs(lq[-1] - le[-1]) <= 2e-3 * max(abs(le[-1]), 1e-3)
+
+
+def test_overlap_salts_the_compile_key(orca_context):
+    """Overlap on/off and the segment override are program shape: each
+    must miss the executable cache (extra_key regression = the golden
+    distinct_train_executables collapse)."""
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    def key_for(cfg):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1, **cfg})
+        it = data_to_iterator(dict(_data()), 32, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        batch = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in batch.x))
+        return est.engine.train_step_cache_key(batch)
+
+    k_off = key_for({"grad_bucket_mb": 0.001})
+    k_on = key_for({"grad_bucket_mb": 0.001, "comms_overlap": True})
+    k_on2 = key_for({"grad_bucket_mb": 0.001, "comms_overlap": True})
+    k_seg = key_for({"grad_bucket_mb": 0.001, "comms_overlap": True,
+                     "comms_segments": 2})
+    assert None not in (k_off, k_on, k_seg)
+    assert k_on == k_on2                 # same shape -> shared executable
+    assert len({k_off, k_on, k_seg}) == 3
+
+
+def test_overlap_knobs_resolve_and_default_bucket(orca_context,
+                                                  monkeypatch):
+    monkeypatch.setenv("ZOO_COMMS_OVERLAP", "1")
+    monkeypatch.setenv("ZOO_COMMS_SEGMENTS", "3")
+    cfg = CommsConfig.resolve({})
+    assert cfg.active and cfg.overlap and cfg.segments == 3
+    # overlap alone resolves the default bucket size (the pipeline is
+    # bucket-staged by definition)
+    assert cfg.effective_bucket_mb == CommsConfig.DEFAULT_BUCKET_MB
+    # config dict wins over env
+    cfg2 = CommsConfig.resolve({"comms_overlap": False})
+    assert not cfg2.overlap
+    assert "overlap=1" in cfg.fingerprint()
+    assert cfg.fingerprint() != CommsConfig.resolve(
+        {"comms_segments": 0}).fingerprint()
+    with pytest.raises(ValueError, match="comms_segments"):
+        CommsConfig(overlap=True, segments=-1)
+
+
+def test_overlapped_rs_spans_in_perfetto_timeline(orca_context):
+    """Per-bucket ``comms.rs_start``/``comms.rs_done`` markers land on the
+    step timeline under the dispatch span's trace and survive the
+    Perfetto export — the attribution surface the stall analysis reads."""
+    from analytics_zoo_tpu.obs import trace
+    from analytics_zoo_tpu.obs.export import perfetto_trace
+
+    with trace.tracing():
+        _, est = _fit({"grad_bucket_mb": 0.001, "comms_overlap": True},
+                      epochs=1, sharded_update=True)
+        spans = trace.spans()
+    n_b = len(est.engine.comms.layout.bucket_sizes)
+    by = {}
+    for s in spans:
+        by.setdefault(s.name, []).append(s)
+    starts, dones = by.get("comms.rs_start", []), by.get("comms.rs_done", [])
+    assert {s.attrs["bucket"] for s in starts} == set(range(n_b))
+    assert {s.attrs["bucket"] for s in dones} == set(range(n_b))
+    assert all(s.attrs["wire_bytes"] > 0 and s.attrs["modeled"]
+               for s in starts)
+    # chained into the dispatch trace, not floating as their own roots
+    disp_traces = {s.trace_id for s in by["engine.dispatch"]}
+    assert all(s.trace_id in disp_traces for s in starts + dones)
+    doc = perfetto_trace(spans)
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert {"comms.rs_start", "comms.rs_done"} <= names
+    # disarmed runs record nothing (the hook is one flag check)
+    trace.clear()
+    _fit({"grad_bucket_mb": 0.001, "comms_overlap": True}, epochs=1)
+    assert not trace.spans()
