@@ -24,11 +24,28 @@ Five legs mirror ``bench.py bench_comms`` on the 8-device simulated mesh:
   bytes must stay byte-for-byte what the bucketed leg moves (the padded
   total is invariant to the bucket split), so overlap can never trade
   launch position for extra bytes unnoticed.
+* ``hierarchical``      — two-level ICI×DCN wire (PR 12): multi-bucket
+  ZeRO-1 over a simulated 2-host × 4-chip factorization of the dp axis.
+  Its contract pins the **per-axis** split (collectives classified by
+  replica-group shape) and ``dcn_wire_bytes`` — the number the
+  hierarchy exists to shrink — so a regression that moves gradient
+  bytes back onto the cross-host links fails even with totals unchanged.
+
+A second golden file, ``tests/goldens/multihost_contracts.json``, pins
+the hierarchical step's contract on the REAL two-process
+``jax.distributed`` topology (2 processes × 4 virtual devices — the
+same (dcn=2, ici=4) factorization, but probed from process locality
+instead of forced): cross-host launch counts and DCN wire bytes,
+checked by ``tests/test_multihost.py`` through the two-process harness.
+The lowered program depends only on the (n_dev, dcn, ici) factorization
+and shapes — not on which process hosts which chip — so
+``--update-multihost`` regenerates it on the single-process simulated
+mesh and the harness verifies the real topology lowers to exactly it.
 
 Regenerate after an *intentional* program change::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
-        python -m analytics_zoo_tpu.analysis.golden --update
+        python -m analytics_zoo_tpu.analysis.golden --update --update-multihost
 
 ``--check`` (the CI gate) exits 1 on drift and prints one line per
 changed field.
@@ -41,12 +58,15 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-from .hlo_lint import HloLinter, collective_counts, parse_collectives
+from .hlo_lint import (HloLinter, collective_counts, collectives_by_axis,
+                       parse_collectives)
 
-__all__ = ["capture_contracts", "check", "diff_contracts", "golden_path",
-           "load_goldens", "save_goldens"]
+__all__ = ["capture_contracts", "capture_multihost_contract", "check",
+           "check_multihost", "diff_contracts", "golden_path",
+           "load_goldens", "multihost_golden_path", "save_goldens"]
 
 GOLDEN_FILE = "program_contracts.json"
+MULTIHOST_GOLDEN_FILE = "multihost_contracts.json"
 
 # contract legs: name -> (estimator config, estimator kwargs)
 # overlapped uses SMALL buckets on purpose: a multi-bucket layout is the
@@ -61,6 +81,9 @@ _LEGS = [
      {}),
     ("overlapped", {"grad_bucket_mb": 0.001, "comms_overlap": True},
      {"sharded_update": True}),
+    ("hierarchical", {"grad_bucket_mb": 0.001, "comms_hierarchy": True,
+                      "comms_dcn_axis": 2},
+     {"sharded_update": True}),
 ]
 
 
@@ -70,6 +93,11 @@ def golden_path(root: Optional[str] = None) -> str:
             os.path.dirname(os.path.abspath(__file__)))), "tests",
             "goldens")
     return os.path.join(root, GOLDEN_FILE)
+
+
+def multihost_golden_path(root: Optional[str] = None) -> str:
+    return os.path.join(os.path.dirname(golden_path(root)),
+                        MULTIHOST_GOLDEN_FILE)
 
 
 def _bench_model():
@@ -164,9 +192,20 @@ def capture_contracts() -> Dict[str, Any]:
         if declared is not None:
             keep = ("buckets", "collectives_per_step", "wire_bytes_per_step",
                     "grad_leaves", "sharded_update", "wire_dtype",
-                    "grad_bytes_f32", "overlap", "segments")
+                    "grad_bytes_f32", "overlap", "segments", "hierarchy")
             entry["declared"] = {k: declared[k] for k in keep
                                  if k in declared}
+            hier = declared.get("hierarchy") or {}
+            if hier.get("active"):
+                # per-axis contract: the launch/byte split between the
+                # fast (ICI) and expensive (DCN) links, classified by
+                # replica-group shape
+                ax = collectives_by_axis(ops, int(hier["ici_axis"]),
+                                         int(hier["dcn_axis"]))
+                entry["by_axis"] = {k: ax[k]
+                                    for k in ("ici", "dcn", "global")}
+                entry["ici_wire_bytes"] = int(ax["ici_wire_bytes"])
+                entry["dcn_wire_bytes"] = int(ax["dcn_wire_bytes"])
             # the accounting rule run right here: measured bytes/launches
             # vs declared — a contract is only golden when they agree
             findings = linter.lint_text(text, label=f"golden:{name}",
@@ -186,7 +225,118 @@ def capture_contracts() -> Dict[str, Any]:
         contracts["overlapped_wire_matches_bucketed"] = (
             contracts["overlapped"]["rs_wire_bytes"]
             == contracts["bucketed_sharded"]["rs_wire_bytes"])
+    # the hierarchy's reason to exist, pinned: the cross-host leg moves at
+    # most 1/host_count of what the flat dp wire would push through DCN
+    # (for the same layout the flat wire's bytes are the ICI leg's f32
+    # bytes — padded_total × 4)
+    if "hierarchical" in contracts:
+        entry = contracts["hierarchical"]
+        dcn = int(entry["declared"]["hierarchy"]["dcn_axis"])
+        contracts["hierarchical_dcn_shrink_ok"] = (
+            entry["dcn_wire_bytes"] * dcn <= entry["ici_wire_bytes"])
     return contracts
+
+
+# ---------------------------------------------------------------------------
+# multihost contract — the hierarchical step on a real (or real-shaped)
+# cross-process mesh
+# ---------------------------------------------------------------------------
+def capture_multihost_contract(mesh=None, dcn: int = 0) -> Dict[str, Any]:
+    """Lower the hierarchical train step over ``mesh`` and measure its
+    per-axis program contract — cross-host launch counts and DCN wire
+    bytes.
+
+    Called two ways, which must agree field-for-field:
+
+    * from the two-process harness (``tests/test_multihost.py``) with the
+      real ``jax.distributed`` global mesh and ``dcn=0`` — the (dcn, ici)
+      factorization is then PROBED from process locality
+      (``mesh.dp_topology``), so the test covers the probe end-to-end;
+    * from ``--update-multihost`` / the single-process suite with the
+      8-device simulated mesh and ``dcn=2`` forced — the lowered program
+      depends only on the factorization and shapes, not on process
+      placement, so this regenerates exactly what the harness measures.
+
+    Lowering-only AND placement-free: the engine state is built as
+    ``ShapeDtypeStruct`` pytrees (module shapes from a host-side init,
+    optimizer shapes via ``eval_shape``), so nothing is device_put,
+    compiled or executed — which is what lets the two-process golden
+    check run even on jaxlib builds without multiprocess CPU collectives
+    (where even ``device_put`` to a cross-process sharding trips a
+    consistency psum, and the *execution* leg must skip).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..orca.learn.engine import TrainEngine
+    from ..orca.learn.utils import Batch
+    from ..parallel import comms as comms_lib
+
+    if mesh is None:
+        from ..common.context import get_context
+        mesh = get_context().mesh
+    cfg = comms_lib.CommsConfig(bucket_mb=0.001, hierarchy=True,
+                                dcn_size=int(dcn))
+    eng = TrainEngine(_bench_model(), optax.adam(1e-3),
+                      lambda y, p: (p - y) ** 2, {}, mesh, seed=0,
+                      compile_cache=False, comms=cfg)
+    data = _bench_data()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    x, y = data["x"][:4 * n_dev], data["y"][:4 * n_dev]
+
+    # abstract twin of eng.build(): same init, same layout, no placement
+    sds = lambda l: jax.ShapeDtypeStruct(  # noqa: E731
+        np.shape(l), np.asarray(l).dtype)
+    variables = dict(eng._init_vars(jax.random.PRNGKey(eng.seed),
+                                    (jnp.asarray(x[:1]),)))
+    params = variables.pop("params", {})
+    eng._build_comms(params)
+    eng.params = jax.tree.map(sds, params)
+    eng.extra_vars = jax.tree.map(sds, variables)
+    eng.opt_state = jax.eval_shape(eng.tx.init, eng.params)
+    eng.step = 0
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh_x = NamedSharding(mesh, P(("dp",), *([None] * (x.ndim - 1))))
+    sh_y = NamedSharding(mesh, P(("dp",)))
+    batch = Batch(x=(jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                          sharding=sh_x),),
+                  y=(jax.ShapeDtypeStruct(y.shape, y.dtype,
+                                          sharding=sh_y),),
+                  w=None)
+
+    fn = eng.ensure_jit_train()
+    args = list(eng.train_step_args(batch))
+    args[4] = jax.ShapeDtypeStruct((), np.dtype("int32"))   # step counter
+    text = fn.lower(*args).as_text()
+    ops = parse_collectives(text)
+    lo = eng.comms.layout
+    ax = collectives_by_axis(ops, lo.ici, lo.dcn)
+    declared = eng.comms_snapshot()
+    findings = HloLinter().lint_text(text, label="golden:multihost",
+                                     declared=declared)
+    return {
+        "n_dev": lo.n_dev, "dcn_axis": lo.dcn, "ici_axis": lo.ici,
+        "buckets": len(lo.bucket_sizes),
+        "collectives": collective_counts(ops),
+        "by_axis": {k: ax[k] for k in ("ici", "dcn", "global")},
+        "ici_wire_bytes": int(ax["ici_wire_bytes"]),
+        "dcn_wire_bytes": int(ax["dcn_wire_bytes"]),
+        "declared_dcn_wire_bytes": int(
+            declared["hierarchy"]["dcn_wire_bytes_per_step"]),
+        "accounting_verified": not findings,
+    }
+
+
+def check_multihost(measured: Dict[str, Any],
+                    path: Optional[str] = None) -> Tuple[bool, List[str]]:
+    """Diff a measured multihost contract against the committed golden."""
+    with open(path or multihost_golden_path(), encoding="utf-8") as f:
+        golden = json.load(f)
+    delta = diff_contracts(golden, measured)
+    return (not delta, delta)
 
 
 # ---------------------------------------------------------------------------
@@ -265,18 +415,33 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="diff current tree vs committed goldens; exit 1 "
                          "on drift")
+    ap.add_argument("--update-multihost", action="store_true",
+                    help="regenerate the multihost contract (captured on "
+                         "the simulated (dcn=2, ici=4) mesh; verified "
+                         "against the real 2-process topology by "
+                         "tests/test_multihost.py)")
     ap.add_argument("--path", default=None, help="golden file override")
     args = ap.parse_args(argv)
     _init_mesh()
-    if args.update:
-        contracts = capture_contracts()
-        path = save_goldens(contracts, args.path)
-        print(f"wrote {path}")
-        for name, _, _ in _LEGS:
-            entry = contracts[name]
-            print(f"  {name}: collectives={entry['collectives']} "
-                  f"rs_wire_bytes={entry['rs_wire_bytes']} "
-                  f"donation={entry['donation']}")
+    if args.update or args.update_multihost:
+        if args.update:
+            contracts = capture_contracts()
+            path = save_goldens(contracts, args.path)
+            print(f"wrote {path}")
+            for name, _, _ in _LEGS:
+                entry = contracts[name]
+                print(f"  {name}: collectives={entry['collectives']} "
+                      f"rs_wire_bytes={entry['rs_wire_bytes']} "
+                      f"donation={entry['donation']}")
+        if args.update_multihost:
+            contract = capture_multihost_contract(dcn=2)
+            mh_path = multihost_golden_path()
+            with open(mh_path, "w", encoding="utf-8") as f:
+                json.dump(contract, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"wrote {mh_path}")
+            print(f"  multihost: by_axis={contract['by_axis']} "
+                  f"dcn_wire_bytes={contract['dcn_wire_bytes']}")
         return 0
     ok, delta = check(args.path)
     if ok:

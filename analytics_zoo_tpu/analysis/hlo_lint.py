@@ -24,7 +24,16 @@ silent until pod scale). Rules:
                       ``data_pipeline_stats()["comms"]`` declares (the
                       engine registers its :meth:`CommsPlan.summary` via
                       :func:`declare_comms`); the PR-8 numbers become
-                      verified, not asserted.
+                      verified, not asserted. For the hierarchical
+                      two-level wire the bookkeeping is **per axis**:
+                      every collective's ``replica_groups`` shape
+                      classifies it as an ICI leg (``dcn`` groups of
+                      ``ici`` members), a DCN leg (``ici`` groups of
+                      ``dcn`` members) or a global reduction, and
+                      launch counts + wire bytes are checked per leg —
+                      a regression that silently moves gradient bytes
+                      from the fast links onto DCN fails the gate even
+                      when the total is unchanged.
 
 The hook (:func:`on_lowering`) is governed by ``ZOO_HLO_LINT``: ``warn``
 (default — log + collect into :func:`lint_report`), ``strict`` (raise
@@ -47,8 +56,8 @@ from ..common import knobs
 logger = logging.getLogger("analytics_zoo_tpu")
 
 __all__ = ["CollectiveOp", "HloLintError", "HloLinter", "LintFinding",
-           "collective_counts", "declare_comms", "lint_report",
-           "on_lowering", "parse_collectives"]
+           "collective_counts", "collectives_by_axis", "declare_comms",
+           "lint_report", "on_lowering", "parse_collectives"]
 
 # loss pmean + clip-norm psum (and at most a couple of bookkeeping
 # reductions) legitimately ride a train step beyond the declared gradient
@@ -104,6 +113,32 @@ class CollectiveOp:
     kind: str              # all_reduce / reduce_scatter / all_gather / ...
     operand_bytes: int
     result_bytes: int
+    # replica-group shape (num_groups, group_size) from the op's
+    # replica_groups attribute — what classifies a collective as an ICI
+    # leg, a DCN leg, or a global reduction under the hierarchical wire.
+    # None when the op carries no groups (pre-groups modules).
+    group_shape: Optional[Tuple[int, int]] = None
+
+
+# stablehlo/mhlo attribute form: replica_groups = dense<...> : tensor<GxSxi64>
+_GROUPS_DENSE_RE = re.compile(
+    r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<(\d+)x(\d+)xi64>")
+# HLO text form: replica_groups={{0,1,2,3},{4,5,6,7}}
+_GROUPS_HLO_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+
+
+def _group_shape(line: str) -> Optional[Tuple[int, int]]:
+    m = _GROUPS_DENSE_RE.search(line)
+    if m is not None:
+        return int(m.group(1)), int(m.group(2))
+    m = _GROUPS_HLO_RE.search(line)
+    if m is not None:
+        groups = re.findall(r"\{([^}]*)\}", m.group(1))
+        sizes = {len([t for t in g.split(",") if t.strip()])
+                 for g in groups}
+        if len(sizes) == 1:
+            return len(groups), sizes.pop()
+    return None
 
 
 def _tensor_bytes(types: str) -> int:
@@ -153,14 +188,16 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
             operand, result = _signature(i)
             out.append(CollectiveOp(kind=m.group(1).replace("-", "_"),
                                     operand_bytes=operand,
-                                    result_bytes=result))
+                                    result_bytes=result,
+                                    group_shape=_group_shape(line)))
             continue
         m = _COLLECTIVE_RE.search(line)
         if not m:
             continue
         operand, result = _signature(i)
         out.append(CollectiveOp(kind=m.group(1), operand_bytes=operand,
-                                result_bytes=result))
+                                result_bytes=result,
+                                group_shape=_group_shape(line)))
     return out
 
 
@@ -170,6 +207,36 @@ def collective_counts(ops: Sequence[CollectiveOp]) -> Dict[str, int]:
     for op in ops:
         counts[op.kind] = counts.get(op.kind, 0) + 1
     return counts
+
+
+def collectives_by_axis(ops: Sequence[CollectiveOp], ici: int, dcn: int
+                        ) -> Dict[str, Any]:
+    """Per-axis split of a hierarchical program's collectives, classified
+    by replica-group shape: the ICI leg runs ``dcn`` groups of ``ici``
+    members, the DCN leg ``ici`` groups of ``dcn`` members; full-axis
+    reductions (loss/clip bookkeeping) and group-less ops are
+    ``global``. ``*_wire_bytes`` sums the gradient-exchange operands
+    (reduce-scatter + all-reduce; the param all-gather is accounted
+    separately, as everywhere in the comms plane). Shared by the
+    accounting rule, the golden capture and ``bench_comms``."""
+    ici_shape, dcn_shape = (dcn, ici), (ici, dcn)
+    out: Dict[str, Any] = {"ici": {}, "dcn": {}, "global": {},
+                           "ici_wire_bytes": 0, "dcn_wire_bytes": 0,
+                           "ambiguous": ici == dcn}
+    for op in ops:
+        if op.group_shape == ici_shape and ici != dcn:
+            leg = "ici"
+        elif op.group_shape == dcn_shape:
+            # ici == dcn makes the two shapes identical; DCN wins the
+            # label and callers must fall back to combined totals
+            leg = "dcn"
+        else:
+            leg = "global"
+        out[leg][op.kind] = out[leg].get(op.kind, 0) + 1
+        if leg in ("ici", "dcn") and op.kind in ("reduce_scatter",
+                                                 "all_reduce"):
+            out[f"{leg}_wire_bytes"] += op.operand_bytes
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -359,6 +426,12 @@ class HloLinter:
                          **details}))
 
         buckets = int(declared.get("buckets") or 0)
+        hier = declared.get("hierarchy") or {}
+        if buckets > 0 and hier.get("active"):
+            findings += self._accounting_hier(ops, label, declared, hier)
+            if not findings and self.record_verified:
+                _record_verified(label, counts, declared)
+            return findings
         if buckets > 0:
             rs, ag = counts.get("reduce_scatter", 0), counts.get(
                 "all_gather", 0)
@@ -398,6 +471,111 @@ class HloLinter:
                       f"margin")
         if not findings and self.record_verified:
             _record_verified(label, counts, declared)
+        return findings
+
+    def _accounting_hier(self, ops: Sequence[CollectiveOp], label: str,
+                         declared: Dict[str, Any],
+                         hier: Dict[str, Any]) -> List[LintFinding]:
+        """Per-axis accounting for the two-level wire: classify every
+        collective by its replica-group shape and check launch counts and
+        wire bytes per leg against what the plan declares."""
+        findings: List[LintFinding] = []
+        buckets = int(declared["buckets"])
+        sharded = bool(declared.get("sharded_update"))
+        wire = declared.get("wire_dtype")
+        qdcn = bool(hier.get("quantize_dcn", True))
+        ici_n, dcn_n = int(hier["ici_axis"]), int(hier["dcn_axis"])
+        ax = collectives_by_axis(ops, ici_n, dcn_n)
+
+        def _fail(msg, **details):
+            findings.append(LintFinding(
+                rule="comms-accounting", severity="error", label=label,
+                message=msg,
+                details={"by_axis": {k: ax[k] for k in
+                                     ("ici", "dcn", "global")},
+                         "declared": declared, **details}))
+
+        if ax["ambiguous"]:
+            # ici == dcn: group shapes cannot tell the legs apart, but
+            # collective KIND still can for most of the contract (RS
+            # rides ICI — plus DCN under ZeRO-1 — AR only ever rides
+            # DCN, grouped AG only ICI/the two-stage gather), and the
+            # combined grouped wire bytes remain exactly checkable
+            def _leg(kind):
+                return (ax["ici"].get(kind, 0) + ax["dcn"].get(kind, 0))
+
+            rs_total, ag_total = _leg("reduce_scatter"), _leg("all_gather")
+            want_rs = 2 * buckets if sharded else buckets
+            if rs_total != want_rs:
+                _fail(f"hierarchical program launches {rs_total} grouped "
+                      f"reduce-scatters but accounting declares {want_rs} "
+                      f"(ici==dcn: legs indistinguishable by group shape)")
+            if sharded:
+                if ag_total != 2:
+                    _fail(f"two-stage param all-gather expected 2 grouped "
+                          f"launches, measured {ag_total} (ici==dcn)")
+            else:
+                ar_total = _leg("all_reduce")
+                if ar_total != buckets:
+                    _fail(f"DCN leg launches {ar_total} grouped "
+                          f"all-reduces but accounting declares "
+                          f"{buckets} buckets (ici==dcn)")
+                if ag_total != buckets:
+                    _fail(f"ICI leg launches {ag_total} grouped "
+                          f"all-gathers but accounting declares "
+                          f"{buckets} buckets (ici==dcn)")
+            if wire != "int8":
+                measured = ax["ici_wire_bytes"] + ax["dcn_wire_bytes"]
+                want = (int(hier.get("ici_wire_bytes_per_step", 0))
+                        + int(hier.get("dcn_wire_bytes_per_step", 0)))
+                if measured != want:
+                    _fail(f"grouped legs move {measured} B/step combined "
+                          f"in the lowered program but accounting "
+                          f"declares {want} B/step (ici==dcn: per-leg "
+                          f"split not attributable)")
+            return findings
+        rs_ici = ax["ici"].get("reduce_scatter", 0)
+        if rs_ici != buckets:
+            _fail(f"ICI leg launches {rs_ici} reduce-scatters but "
+                  f"accounting declares {buckets} buckets")
+        if sharded:
+            rs_dcn = ax["dcn"].get("reduce_scatter", 0)
+            if rs_dcn != buckets:
+                _fail(f"DCN leg launches {rs_dcn} reduce-scatters but "
+                      f"accounting declares {buckets} buckets (ZeRO-1)")
+            ag_dcn = ax["dcn"].get("all_gather", 0)
+            ag_ici = ax["ici"].get("all_gather", 0)
+            if (ag_dcn, ag_ici) != (1, 1):
+                _fail(f"two-stage param all-gather expected 1 DCN + 1 ICI "
+                      f"launch, measured {ag_dcn} DCN + {ag_ici} ICI")
+        else:
+            ar_dcn = ax["dcn"].get("all_reduce", 0)
+            if ar_dcn != buckets:
+                _fail(f"DCN leg launches {ar_dcn} all-reduces but "
+                      f"accounting declares {buckets} buckets")
+            ag_ici = ax["ici"].get("all_gather", 0)
+            if ag_ici != buckets:
+                _fail(f"ICI leg launches {ag_ici} all-gathers but "
+                      f"accounting declares {buckets} buckets")
+        # wire-byte equality per leg. int8 is a simulated wire (values
+        # dequantized before the reduce), so byte equality is skipped for
+        # whichever leg carries it; bf16 really rides the collective.
+        ici_quant = wire != "f32" and not qdcn
+        dcn_quant = wire != "f32" and qdcn
+        if not (wire == "int8" and ici_quant):
+            measured = ax["ici_wire_bytes"]
+            want = int(hier.get("ici_wire_bytes_per_step", 0))
+            if measured != want:
+                _fail(f"ICI leg moves {measured} B/step in the lowered "
+                      f"program but accounting declares {want} B/step",
+                      measured_ici_bytes=measured)
+        if not (wire == "int8" and dcn_quant):
+            measured = ax["dcn_wire_bytes"]
+            want = int(hier.get("dcn_wire_bytes_per_step", 0))
+            if measured != want:
+                _fail(f"DCN leg moves {measured} B/step in the lowered "
+                      f"program but accounting declares {want} B/step",
+                      measured_dcn_bytes=measured)
         return findings
 
 

@@ -83,6 +83,18 @@ _KNOBS = [
        "Dependency-island override for the overlapped pipeline: 0 = one "
        "segment per bucket (max overlap), 1 = classic post-backward wire, "
        "N = buckets coalesced into N contiguous groups."),
+    _k("ZOO_COMMS_HIERARCHY", "bool", False, "comms",
+       "Two-level ICI x DCN gradient wire: reduce-scatter inside each "
+       "host group, exchange only the already-reduced 1/ici chunks "
+       "across hosts — DCN moves 1/ici of the flat wire's bytes."),
+    _k("ZOO_COMMS_DCN_AXIS", "int", 0, "comms",
+       "Host-group count for the hierarchical wire: 0 = probe process "
+       "locality (mesh.dp_topology), N = force an N-host factorization "
+       "of the dp axis (the simulated mesh's stand-in for a pod)."),
+    _k("ZOO_COMMS_QUANTIZE_DCN", "bool", True, "comms",
+       "With the hierarchical wire and a non-f32 allreduce dtype, "
+       "quantize only the cross-host (DCN) leg — the ICI leg reduces "
+       "exact f32. 0 = quantize the whole wire as the classic path does."),
     _k("ZOO_EMBED_GRAD_MODE", "str", "auto", "comms",
        "Embedding gradient exchange: auto | dense | sparse."),
     # --- checkpoint plane ---------------------------------------------------

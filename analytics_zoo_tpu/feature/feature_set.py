@@ -41,15 +41,37 @@ def _as_tuple(v) -> Tuple:
 class DiskFeatureSet:
     """Disk-backed column store; duck-types the BatchIterator contract
     (``epoch()``/``steps_per_epoch``/``_host_batches``) that
-    ``TPUEstimator.fit`` and the bench consume."""
+    ``TPUEstimator.fit`` and the bench consume.
+
+    Two multihost striping modes (``stripe``):
+
+    * ``"row"`` (default) — every process strides the global row index
+      space (process p takes rows p, p+nproc, ...), so all processes
+      touch every shard file. Bit-compatible with the pre-PR-12 stream.
+    * ``"shard"`` — balanced SHARD-level striping: whole shard files are
+      assigned to processes (greedy longest-first balance on row
+      counts, deterministic — every process computes the identical
+      assignment), so **each process opens only its own stripe of the
+      dataset**. On a pod that is the difference between every host
+      re-reading the whole dataset over the storage fabric and each
+      host reading 1/nproc of it. All processes emit the same batch
+      count (the min over stripes), so no multihost collective can
+      deadlock on a ragged epoch.
+    """
 
     def __init__(self, cache_dir: str, mesh, batch_size: int,
-                 seed: int = 0, _owns_dir: bool = False):
+                 seed: int = 0, _owns_dir: bool = False,
+                 stripe: str = "row",
+                 _pid: Optional[int] = None, _nproc: Optional[int] = None):
         import jax
 
+        if stripe not in ("row", "shard"):
+            raise ValueError(f"unknown stripe mode {stripe!r} "
+                             "(row | shard)")
         self.cache_dir = cache_dir
         self.mesh = mesh
         self.seed = seed
+        self.stripe = stripe
         self._owns_dir = _owns_dir
         from ..native.infeed import PipelineStats
         self.stats = PipelineStats()    # shared with the estimator's
@@ -61,20 +83,65 @@ class DiskFeatureSet:
         self.n_y: int = meta["n_y"]
         self.shard_rows: List[int] = meta["shard_rows"]
 
-        nproc = jax.process_count()
-        self.local_bs = max(batch_size // max(nproc, 1), 1)
+        # _pid/_nproc exist for the single-process tests to exercise the
+        # multihost striping contract without a jax.distributed session
+        self.pid = jax.process_index() if _pid is None else int(_pid)
+        nproc = jax.process_count() if _nproc is None else int(_nproc)
+        self.nproc = max(nproc, 1)
+        self.local_bs = max(batch_size // self.nproc, 1)
         data_axis = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-        local_div = max(data_axis // max(nproc, 1), 1)
+        local_div = max(data_axis // self.nproc, 1)
         if self.local_bs % local_div:
             self.local_bs = math.ceil(self.local_bs / local_div) * local_div
-        self.global_bs = self.local_bs * max(nproc, 1)
+        self.global_bs = self.local_bs * self.nproc
         # tail rows that don't fill a whole global batch are dropped (jit
         # steps are fixed-shape; a padded tail batch belongs to the DRAM
         # BatchIterator path, which masks via weights)
-        self.steps_per_epoch = self.n // self.global_bs
-        if self.steps_per_epoch == 0:
-            raise ValueError(f"{self.n} rows < local batch {self.local_bs}")
+        if stripe == "shard":
+            self.shard_assignment = self._balanced_assignment(
+                self.shard_rows, self.nproc)
+            stripe_rows = [sum(self.shard_rows[s] for s in shards)
+                           for shards in self.shard_assignment]
+            # every process must emit the SAME batch count — the min
+            # stripe bounds the epoch (balance keeps the waste ~0)
+            self.steps_per_epoch = min(stripe_rows) // self.local_bs
+            if self.steps_per_epoch == 0:
+                # not a batch-size problem: the smallest stripe cannot
+                # fill one local batch — too few / too coarse shard
+                # files for this process count
+                raise ValueError(
+                    f"shard striping: the smallest of {self.nproc} "
+                    f"stripes holds {min(stripe_rows)} rows (< local "
+                    f"batch {self.local_bs}) from "
+                    f"{len(self.shard_rows)} shard file(s) — rewrite "
+                    f"the cache with a smaller shard_size (or use "
+                    f"stripe='row')")
+        else:
+            self.shard_assignment = None
+            self.steps_per_epoch = self.n // self.global_bs
+            if self.steps_per_epoch == 0:
+                raise ValueError(
+                    f"{self.n} rows < global batch {self.global_bs}")
         self._epoch_idx = 0
+
+    @staticmethod
+    def _balanced_assignment(shard_rows: Sequence[int], nproc: int
+                             ) -> List[List[int]]:
+        """Whole shards -> processes, balanced on row counts: greedy
+        longest-first onto the lightest stripe (ties by pid). Pure
+        function of (shard_rows, nproc), so every process derives the
+        identical assignment with no coordination."""
+        order = sorted(range(len(shard_rows)),
+                       key=lambda s: (-shard_rows[s], s))
+        loads = [0] * nproc
+        out: List[List[int]] = [[] for _ in range(nproc)]
+        for s in order:
+            p = min(range(nproc), key=lambda q: (loads[q], q))
+            out[p].append(s)
+            loads[p] += shard_rows[s]
+        for stripe in out:
+            stripe.sort()
+        return out
 
     # --- construction -------------------------------------------------------
     @staticmethod
@@ -107,17 +174,20 @@ class DiskFeatureSet:
                        mmap_mode="r")
 
     def _host_batches(self, shuffle: bool) -> Iterator:
-        import jax
         from ..orca.learn.utils import Batch
 
         rng = np.random.RandomState(self.seed + self._epoch_idx)
         self._epoch_idx += 1
         shard_order = np.arange(len(self.shard_rows))
         if shuffle:
+            # one rng, advanced identically on every process (the
+            # shard-stripe filter below happens AFTER the shuffle), so
+            # multihost epochs stay coordinated without a coordinator
             rng.shuffle(shard_order)
 
-        pid = jax.process_index()
-        nproc = max(jax.process_count(), 1)
+        pid, nproc = self.pid, self.nproc
+        own_shards = (set(self.shard_assignment[pid])
+                      if self.shard_assignment is not None else None)
         w = None  # full batches only; jit synthesizes the unit weights
         # carry buffers span shard boundaries so batches are exact-size
         carry_x: List[List[np.ndarray]] = [[] for _ in range(self.n_x)]
@@ -143,15 +213,25 @@ class DiskFeatureSet:
                 emitted += 1
                 yield Batch(x=tuple(xs), y=tuple(ys) or None, w=w)
 
-        # stripe over the GLOBAL row index space so every process gets the
-        # same row count (+-1) regardless of per-shard row counts — unequal
-        # stripes would make processes emit different batch counts and
-        # deadlock the collective in a multihost step
+        # row mode: stripe over the GLOBAL row index space so every
+        # process gets the same row count (+-1) regardless of per-shard
+        # row counts — unequal stripes would make processes emit
+        # different batch counts and deadlock the collective in a
+        # multihost step. shard mode: each process touches ONLY the
+        # shard files of its balanced stripe (each host reads 1/nproc of
+        # the dataset); equal batch counts come from steps_per_epoch =
+        # min stripe // local_bs, enforced by drain()'s emitted cap.
         global_offset = 0
         for s in shard_order:
             rows = self.shard_rows[s]
-            start = (pid - global_offset) % nproc
-            local = np.arange(start, rows, nproc)
+            if own_shards is not None:
+                if s not in own_shards:
+                    global_offset += rows
+                    continue
+                local = np.arange(rows)
+            else:
+                start = (pid - global_offset) % nproc
+                local = np.arange(start, rows, nproc)
             global_offset += rows
             if shuffle:
                 rng.shuffle(local)
@@ -202,7 +282,8 @@ class FeatureSet:
     def from_arrays(data: Dict[str, Any], tier: str = "dram",
                     mesh=None, batch_size: int = 32,
                     cache_dir: Optional[str] = None,
-                    shard_size: int = 65536, seed: int = 0):
+                    shard_size: int = 65536, seed: int = 0,
+                    stripe: str = "row"):
         tier = tier.lower()
         if tier == "dram":
             from ..orca.learn import utils as learn_utils
@@ -219,7 +300,7 @@ class FeatureSet:
             cache_dir = cache_dir or tempfile.mkdtemp(prefix="zoo_diskfs_")
             DiskFeatureSet.write(data, cache_dir, shard_size=shard_size)
             return DiskFeatureSet(cache_dir, mesh, batch_size, seed=seed,
-                                  _owns_dir=owns)
+                                  _owns_dir=owns, stripe=stripe)
         raise ValueError(f"unknown tier {tier!r} (dram | disk); the "
                          "reference's PMEM tier has no TPU-host analogue — "
                          "use disk")

@@ -107,10 +107,12 @@ def snapshot_ckpt() -> int:
 
 def snapshot_comms() -> int:
     """Bucketed reduce-scatter + ZeRO-1 sharded update + the overlapped
-    backward–comms pipeline on the 8-device simulated mesh — buckets,
-    wire bytes/step, collective launches, bit-identity to flat psum, and
-    overlap stall attribution (wall-time delta vs the post-backward
-    wire, wire-byte parity)."""
+    backward–comms pipeline + the hierarchical two-level wire on the
+    8-device simulated mesh — buckets, wire bytes/step, collective
+    launches, bit-identity to flat psum, overlap stall attribution
+    (wall-time delta vs the post-backward wire, wire-byte parity), and
+    the ICI×DCN split (dp factored as 2 simulated hosts × 4 chips; DCN
+    wire bytes are the hierarchy's point)."""
     _ensure_sim_devices()
     import time
 
@@ -157,8 +159,19 @@ def snapshot_comms() -> int:
     lo, est_o, dt_overlap = run_cfg(
         {"grad_bucket_mb": 0.001, "comms_overlap": True}, timed=True,
         sharded_update=True)
+    # hierarchical pair: the same layout on the two-level wire (2
+    # simulated hosts x 4 chips); bit-identity holds WITHIN the
+    # two-level family (vs its overlapped variant) — vs the flat wire it
+    # differs at reduction-association level (parallel/comms.py)
+    lh, est_h, _ = run_cfg({"grad_bucket_mb": 0.001,
+                            "comms_hierarchy": True, "comms_dcn_axis": 2},
+                           sharded_update=True)
+    lho, _, _ = run_cfg({"grad_bucket_mb": 0.001, "comms_hierarchy": True,
+                         "comms_dcn_axis": 2, "comms_overlap": True},
+                        sharded_update=True)
     snap = est.data_pipeline_stats()["comms"]
     osnap = est_o.data_pipeline_stats()["comms"]
+    hsnap = est_h.data_pipeline_stats()["comms"]
     keys = ("buckets", "collectives_per_step", "wire_bytes_per_step",
             "grad_leaves", "sharded_update", "wire_dtype",
             "opt_shard_elems")
@@ -171,6 +184,13 @@ def snapshot_comms() -> int:
         "wire_bytes_unchanged": (osnap.get("wire_bytes_per_step")
                                  == snap.get("wire_bytes_per_step")),
         "stall_hidden_s": round(max(0.0, dt_base - dt_overlap), 3)}
+    hh = hsnap.get("hierarchy", {})
+    out["hierarchy"] = {
+        "ici_axis": hh.get("ici_axis"),
+        "dcn_axis": hh.get("dcn_axis"),
+        "dcn_wire_bytes": hh.get("dcn_wire_bytes_per_step"),
+        "ici_wire_bytes": hh.get("ici_wire_bytes_per_step"),
+        "bit_identical": lh == lho}
     return _emit("COMMS_PLANE", out)
 
 

@@ -206,17 +206,30 @@ class TrainEngine:
             raise ValueError("comms plane does not support tensor-parallel "
                              "partitioned params")
         n = self.mesh.shape.get(self.comms_cfg.axis, 1)
-        layout = comms_lib.build_layout(params, n, self.comms_cfg)
+        ici, dcn = n, 1
+        if self.comms_cfg.hierarchy:
+            # two-level wire: factor the dp axis into (dcn, ici) from
+            # process locality; ZOO_COMMS_DCN_AXIS imposes the simulated
+            # split on a single-process mesh. A (1, n) factorization
+            # collapses the plan onto the classic single-level wire.
+            from ...parallel.mesh import dp_topology
+            dcn, ici = dp_topology(
+                self.mesh, self.comms_cfg.axis,
+                dcn_override=self.comms_cfg.dcn_size or None)
+        layout = comms_lib.build_layout(params, n, self.comms_cfg,
+                                        ici=ici, dcn=dcn)
         self.comms = comms_lib.CommsPlan(self.comms_cfg, layout)
         if self.comms_cfg.quantized and self.comms_resid is None:
             self.comms_resid = self._zero_resid()
 
     def _zero_resid(self):
         # created ON device, sharded — a host np.zeros would pay
-        # n_dev x param-size of pointless H2D at every build/restore
+        # n_dev x param-size of pointless H2D at every build/restore.
+        # resid_elems: flat domain classically, the post-ICI chunk domain
+        # when only the DCN leg quantizes
         lo = self.comms.layout
         return jax.jit(
-            lambda: jnp.zeros((lo.n_dev, lo.padded_total), jnp.float32),
+            lambda: jnp.zeros((lo.n_dev, lo.resid_elems), jnp.float32),
             out_shardings=NamedSharding(self.mesh, P(self.comms.axis)))()
 
     def _init_sharded_opt(self, params):
@@ -231,7 +244,11 @@ class TrainEngine:
         build before the resharding ``device_put`` ran."""
         lo = self.comms.layout
         host = jax.device_get(params)
-        flat = lo.to_scattered_np(lo.flatten_np(host))
+        # device-major scattered order: row k is the chunk device k OWNS
+        # after the (possibly two-level) reduce-scatter, so P(dp) places
+        # each replica's own moments (σ-permuted under hierarchy,
+        # identical to chunk-major on the flat wire)
+        flat = lo.to_device_scattered_np(lo.flatten_np(host))
         flat_dev = jax.device_put(
             flat, NamedSharding(self.mesh, P(self.comms.axis)))
         state_shape = jax.eval_shape(
@@ -534,21 +551,30 @@ class TrainEngine:
         graph). The whole-tree ``flatten`` below is the barrier overlap
         removes."""
         from ...parallel import collective as C
-        n = plan.layout.n_dev
+        lo = plan.layout
+        n = lo.n_dev
+        # the flat-domain EF residual is added at assembly (classic wire,
+        # and the hierarchical classic-quantize variant); the DCN-only
+        # variant's residual lives on the post-ICI chunk domain and is
+        # folded in inside plan.hier_reduce instead
+        flat_resid = resid is not None and lo.resid_elems == lo.padded_total
         if plan.segplan is not None:
             bucket_vals = plan.segplan.bucket_values(grads)
-            if resid is not None:
+            if flat_resid:
                 # per-bucket residual add keeps each bucket's dependence
                 # cone its own (resid is a step input, not a barrier)
                 bucket_vals = [b + r for b, r in zip(
-                    bucket_vals, plan.layout.buckets(resid[0]))]
+                    bucket_vals, lo.buckets(resid[0]))]
         else:
-            flat = plan.layout.flatten(grads)
-            if resid is not None:
+            flat = lo.flatten(grads)
+            if flat_resid:
                 # error feedback: add back what last step's quantized wire
                 # dropped, and carry forward what this step's drops
                 flat = flat + resid[0]
-            bucket_vals = plan.layout.buckets(flat)
+            bucket_vals = lo.buckets(flat)
+        if plan.hierarchical:
+            return self._comms_hier_exchange_update(
+                plan, params, opt_state, resid, bucket_vals)
         shards, wires = plan.reduce_scatter_bucket_list(bucket_vals)
         if resid is not None:
             # elementwise subtract commutes with the bucket split, so the
@@ -575,6 +601,64 @@ class TrainEngine:
                 mean_flat = mean_flat * scale
             mean_flat = self._comms_const_clip(mean_flat)
             mean_grads = plan.layout.unflatten(mean_flat)
+            updates, new_opt = self.tx.update(mean_grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, new_resid
+
+    def _comms_hier_exchange_update(self, plan, params, opt_state, resid,
+                                    bucket_vals):
+        """Two-level ICI×DCN exchange + update (the pod-scale wire,
+        parallel/comms.py): reduce-scatter each assembled bucket inside
+        the host group over ICI, exchange only the already-reduced
+        ``1/ici`` chunks across hosts over DCN (reduce-scatter under
+        ZeRO-1, allreduce otherwise), then gather back over the cheap
+        links. Composes with the overlapped assembly (``bucket_vals``
+        may come from the segment plan — each bucket's ICI launch keeps
+        its own dependence cone) and the quantized wire (DCN leg only by
+        default). Bit-identical to the classic wire legs *within* the
+        two-level family; differs from the flat wire at reduction-
+        association level (documented in parallel/comms.py)."""
+        from ...parallel import collective as C
+        lo = plan.layout
+        n = lo.n_dev
+        chunk_resid = resid is not None and lo.resid_elems != lo.padded_total
+        out, new_chunk_resid, flat_wires = plan.hier_reduce(
+            bucket_vals, resid[0] if chunk_resid else None)
+        if resid is None:
+            new_resid = resid
+        elif chunk_resid:
+            new_resid = new_chunk_resid[None]
+        else:
+            # classic-wire variant (quantize_dcn off): flat-domain EF,
+            # exactly the classic path's bookkeeping
+            new_resid = jnp.concatenate(
+                [b - w for b, w in zip(bucket_vals, flat_wires)])[None]
+        i = C.axis_index(plan.axis)
+        if plan.cfg.sharded_update:
+            # `out` holds this replica's unique (bucket/n) global shards
+            # — chunk σ(i) of each bucket, which is exactly what
+            # plan.shard_of slices for the params
+            scale = self._comms_clip_scale(out)
+            gshard = jnp.concatenate(out) / n
+            if scale is not None:
+                gshard = gshard * scale
+            gshard = self._comms_const_clip(gshard)
+            pshard = plan.shard_of(lo.flatten(params), i)
+            updates, new_opt = self.tx.update(gshard, opt_state, pshard)
+            new_pshard = optax.apply_updates(pshard, updates)
+            new_flat = plan.unscatter(plan.hier_gather_params(new_pshard))
+            new_params = lo.unflatten(new_flat)
+        else:
+            # `out` holds full global chunks (replicated across the host
+            # group); the clip norm reduces over each replica's UNIQUE
+            # sub-chunk so both update modes compute the identical scale
+            uniq = plan.hier_unique_shards(out, i)
+            scale = self._comms_clip_scale(uniq)
+            mean_flat = plan.hier_gather_buckets(out) / n
+            if scale is not None:
+                mean_flat = mean_flat * scale
+            mean_flat = self._comms_const_clip(mean_flat)
+            mean_grads = lo.unflatten(mean_flat)
             updates, new_opt = self.tx.update(mean_grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
         return new_params, new_opt, new_resid
@@ -1025,7 +1109,7 @@ class TrainEngine:
         if (saved is not None
                 and state.get("comms_layout_sig") == lo.signature()
                 and tuple(np.asarray(saved).shape) == (lo.n_dev,
-                                                       lo.padded_total)):
+                                                       lo.resid_elems)):
             self.comms_resid = jax.device_put(
                 np.asarray(saved),
                 NamedSharding(self.mesh, P(self.comms.axis)))

@@ -29,9 +29,11 @@ def all_gather(x, axis: AxisName = "dp", *, axis_index_groups=None, tiled=True):
                           axis_index_groups=axis_index_groups)
 
 
-def reduce_scatter(x, axis: AxisName = "dp", *, scatter_dimension=0):
+def reduce_scatter(x, axis: AxisName = "dp", *, scatter_dimension=0,
+                   axis_index_groups=None):
     return lax.psum_scatter(x, axis_name=axis,
-                            scatter_dimension=scatter_dimension, tiled=True)
+                            scatter_dimension=scatter_dimension, tiled=True,
+                            axis_index_groups=axis_index_groups)
 
 
 def ppermute_shift(x, axis: AxisName = "sp", shift: int = 1):

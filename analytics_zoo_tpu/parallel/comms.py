@@ -30,6 +30,39 @@ tunable plane instead of whatever GSPMD happens to emit:
   no int8-accumulating allreduce) — byte accounting reports what a native
   int8 wire would move.
 
+* **Hierarchical two-level wire** (``ZOO_COMMS_HIERARCHY``) — every leg
+  above treats the dp axis as one flat ring, which is wrong at pod scale:
+  inside a host the chips talk over ICI at TB/s, across hosts the wire is
+  DCN at tens of GB/s, and a flat collective pays DCN price for the whole
+  gradient (the MLPerf TPU-pod lesson, arXiv:1909.09756; Horovod's
+  hierarchical allreduce, arXiv:1802.05799). The hierarchy factors the dp
+  axis into ``(dcn, ici)`` sub-axes (``parallel/mesh.py:dp_topology`` —
+  process locality on a real multihost mesh, ``ZOO_COMMS_DCN_AXIS`` as
+  the simulated split) and decomposes each bucket's exchange as:
+  reduce-scatter over the ICI group (full bucket rides the fast links,
+  producing per-chip host-partial chunks), then allreduce — or, under
+  ZeRO-1, reduce-scatter — of the already-reduced ``1/ici`` chunks over
+  the DCN group, then all-gather back over ICI. DCN moves ``1/ici`` of
+  the bytes a flat collective would push through it. Bucket boundaries
+  stay aligned so no bucket straddles a host shard (every bucket divides
+  by ``n_dev``, and for the int8 DCN wire by ``ici*block``). The
+  quantized wire composes DCN-side by default (``ZOO_COMMS_QUANTIZE_DCN``):
+  the ICI leg reduces exact f32 and only the cross-host leg — where bytes
+  are expensive — carries bf16/int8 with the error-feedback residual now
+  living on the chunk domain.
+
+  Numerics: the two-level wire sums each element as (host-linear partial
+  sums) then (linear across hosts) — a different floating-point
+  association than the flat wire's single linear reduction, so
+  hierarchical-vs-flat differs at the last-ulp level exactly like
+  entering the plane shifts vs GSPMD (documented below). The bit-identity
+  family holds *within* the two-level wire: single-bucket == bucketed ==
+  overlapped == ZeRO-1-sharded are bit-identical on the f32 mesh (every
+  variant computes the same per-element two-level sum), a ``dcn == 1``
+  factorization collapses byte-for-byte onto the classic bucketed wire,
+  and the whole decomposition is bit-exact against its numpy host twins
+  (:func:`hier_reduce_scatter_np` et al.) — all test-asserted.
+
 * **Overlapped backward–comms pipeline** (``ZOO_COMMS_OVERLAP``) — the
   bucketed wire above still assembles ONE padded flat vector from every
   grad leaf before the first reduce-scatter can launch: that concatenate
@@ -77,7 +110,8 @@ from jax import lax
 from . import collective as C
 
 __all__ = ["CommsConfig", "BucketLayout", "CommsPlan", "SegmentPlan",
-           "build_layout"]
+           "build_layout", "hier_reduce_scatter_np", "hier_allreduce_np",
+           "hier_mean_np", "group_sum_np"]
 
 WIRE_DTYPES = ("f32", "bf16", "int8")
 _WIRE_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
@@ -116,6 +150,21 @@ class CommsConfig:
                    0 = one segment per bucket (maximum overlap), 1 = a
                    single segment (the classic post-backward wire shape),
                    N = buckets coalesced into N contiguous groups.
+    hierarchy    — two-level ICI×DCN wire (``ZOO_COMMS_HIERARCHY`` /
+                   config ``comms_hierarchy``): reduce-scatter inside the
+                   host group, allreduce (ZeRO-1: reduce-scatter) of the
+                   already-reduced chunks across hosts.
+    dcn_size     — host-group count override (``ZOO_COMMS_DCN_AXIS`` /
+                   config ``comms_dcn_axis``): 0 = probe the mesh's
+                   process topology (``mesh.dp_topology``); N = factor
+                   the dp axis into N simulated hosts — the tier-1 mesh's
+                   stand-in for a real pod.
+    quantize_dcn — with ``hierarchy`` and a non-f32 wire, quantize ONLY
+                   the DCN leg (``ZOO_COMMS_QUANTIZE_DCN``, default on):
+                   the ICI leg reduces exact f32; bytes shrink where they
+                   are expensive. Off = the classic wire shape (bucket
+                   quantized before the ICI leg; the DCN leg then moves
+                   f32 host-partial sums).
     """
 
     bucket_mb: float = 0.0
@@ -126,6 +175,9 @@ class CommsConfig:
     explicit: bool = False
     overlap: bool = False
     segments: int = 0
+    hierarchy: bool = False
+    dcn_size: int = 0
+    quantize_dcn: bool = True
 
     DEFAULT_BUCKET_MB = 4.0
 
@@ -140,12 +192,18 @@ class CommsConfig:
             raise ValueError("allreduce block must be >= 1")
         if self.segments < 0:
             raise ValueError("comms_segments must be >= 0")
+        if self.dcn_size < 0:
+            raise ValueError("comms_dcn_axis must be >= 0")
+        if self.dcn_size > 0 and not self.hierarchy:
+            raise ValueError(
+                "comms_dcn_axis only applies to the hierarchical wire — "
+                "set comms_hierarchy/ZOO_COMMS_HIERARCHY too")
 
     @property
     def active(self) -> bool:
         return (self.sharded_update or self.bucket_mb > 0
                 or self.wire_dtype != "f32" or self.explicit
-                or self.overlap)
+                or self.overlap or self.hierarchy)
 
     @property
     def quantized(self) -> bool:
@@ -157,7 +215,8 @@ class CommsConfig:
         unset bucket size resolves to the default when either is on."""
         if self.bucket_mb > 0:
             return self.bucket_mb
-        if self.sharded_update or self.quantized or self.overlap:
+        if (self.sharded_update or self.quantized or self.overlap
+                or self.hierarchy):
             return self.DEFAULT_BUCKET_MB
         return 0.0
 
@@ -166,12 +225,15 @@ class CommsConfig:
         engines whose comms knobs differ must never share an executable.
         The overlap flag and segment override are program shape (where the
         reduce-scatters sit in the dependence graph), so they salt the key
-        exactly like the bucket layout does."""
+        exactly like the bucket layout does; the hierarchy knobs change
+        every collective's replica groups and salt it the same way."""
         return (f"comms:bucket_mb={self.effective_bucket_mb}:"
                 f"sharded={int(self.sharded_update)}:"
                 f"wire={self.wire_dtype}:block={self.block}:"
                 f"axis={self.axis}:overlap={int(self.overlap)}:"
-                f"segments={self.segments}")
+                f"segments={self.segments}:"
+                f"hier={int(self.hierarchy)}:dcn={self.dcn_size}:"
+                f"qdcn={int(self.quantize_dcn)}")
 
     @classmethod
     def resolve(cls, config: Optional[Dict] = None,
@@ -203,9 +265,19 @@ class CommsConfig:
             if raw_ov is not None else False
         segments = int(cfg.get("comms_segments",
                                _env("ZOO_COMMS_SEGMENTS", 0)))
+        raw_h = cfg.get("comms_hierarchy", _env("ZOO_COMMS_HIERARCHY"))
+        hierarchy = str(raw_h).lower() in ("1", "true", "yes", "on") \
+            if raw_h is not None else False
+        dcn_size = int(cfg.get("comms_dcn_axis",
+                               _env("ZOO_COMMS_DCN_AXIS", 0)))
+        raw_q = cfg.get("comms_quantize_dcn",
+                        _env("ZOO_COMMS_QUANTIZE_DCN"))
+        quantize_dcn = str(raw_q).lower() in ("1", "true", "yes", "on") \
+            if raw_q is not None else True
         return cls(bucket_mb=bucket_mb, sharded_update=bool(sharded_update),
                    wire_dtype=wire, block=block, explicit=explicit,
-                   overlap=overlap, segments=segments)
+                   overlap=overlap, segments=segments, hierarchy=hierarchy,
+                   dcn_size=dcn_size, quantize_dcn=quantize_dcn)
 
 
 # ---------------------------------------------------------------------------
@@ -224,11 +296,17 @@ class BucketLayout:
     Two element orders exist:
 
     * **flat order** — leaves concatenated, zero-padded to ``padded_total``.
-    * **scattered order** — replica-major: replica i's reduce-scatter output
-      (its chunk of every bucket, concatenated) is the contiguous slice
-      ``[i*shard_size, (i+1)*shard_size)``. Sharded optimizer state is
-      stored in this order so a plain ``P(axis)`` NamedSharding puts each
-      replica's 1/N on its own chip.
+    * **scattered order** — chunk-major: chunk ``s`` of every bucket,
+      concatenated, is the contiguous slice
+      ``[s*shard_size, (s+1)*shard_size)``. On the flat wire replica ``s``
+      owns chunk ``s``; on the hierarchical wire the two-level
+      reduce-scatter hands device ``k = h*ici + i`` chunk
+      ``σ(k) = i*dcn + h`` instead, so sharded optimizer state is stored
+      **device-major** (row ``k`` = chunk ``σ(k)``; see
+      :meth:`to_device_scattered_np`) and a plain ``P(axis)``
+      NamedSharding still puts each replica's own 1/N on its own chip.
+      Without hierarchy ``σ`` is the identity and device-major ==
+      chunk-major, bit for bit.
     """
 
     treedef: Any
@@ -242,11 +320,16 @@ class BucketLayout:
     shard_size: int
     wire_dtype: str = "f32"
     block: int = 256
+    ici: int = 1            # devices per host group along the dp axis
+    dcn: int = 1            # host groups (1 = flat single-level wire)
+    quantize_dcn: bool = True
 
     # -- construction --------------------------------------------------------
     @staticmethod
     def build(tree, n_dev: int, bucket_mb: float,
-              wire_dtype: str = "f32", block: int = 256) -> "BucketLayout":
+              wire_dtype: str = "f32", block: int = 256,
+              ici: int = 1, dcn: int = 1,
+              quantize_dcn: bool = True) -> "BucketLayout":
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         if not leaves:
             raise ValueError("comms plane: empty parameter tree")
@@ -271,10 +354,24 @@ class BucketLayout:
         dtypes = tuple(str(_dtype(l)) for l in leaves)
         sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
         total = sum(sizes)
+        ici, dcn = int(ici), int(dcn)
+        if ici * dcn != int(n_dev) and dcn > 1:
+            raise ValueError(
+                f"hierarchical layout: ici({ici}) x dcn({dcn}) must equal "
+                f"the dp axis size {n_dev}")
         # every bucket must split evenly over the axis (tiled reduce-scatter)
-        # and, for int8, into whole scale blocks
-        align = n_dev if wire_dtype != "int8" else \
-            (n_dev * block) // math.gcd(n_dev, block)
+        # and, for int8, into whole scale blocks. The host-boundary rule:
+        # divisibility by n_dev already means each bucket splits into ici
+        # whole host chunks of dcn whole sub-chunks — no bucket straddles a
+        # host shard. The int8 DCN-only wire quantizes the (bucket/ici)
+        # chunk, so that chunk must also split into whole scale blocks.
+        if wire_dtype != "int8":
+            align = n_dev
+        elif dcn > 1 and quantize_dcn:
+            per_host = ici * block
+            align = (n_dev * per_host) // math.gcd(n_dev, per_host)
+        else:
+            align = (n_dev * block) // math.gcd(n_dev, block)
         if bucket_mb and bucket_mb > 0:
             target = max(int(bucket_mb * (1 << 20)) // 4, align)
             b = (target // align) * align or align
@@ -289,20 +386,84 @@ class BucketLayout:
             # touches buckets)
             bucket_sizes = [-(-total // align) * align]
         padded_total = sum(bucket_sizes)
+        # a degenerate factorization collapses onto the classic flat wire:
+        # dcn==1 (single host — no cross-host leg) and ici==1 (one chip
+        # per host — no fast links to pre-reduce on, so the "ICI leg"
+        # would be a no-op and the DCN groups would just be the full axis
+        # wearing a hierarchical label)
+        hier = dcn > 1 and ici > 1
         return BucketLayout(
             treedef=treedef, shapes=shapes, dtypes=dtypes, sizes=sizes,
             n_dev=int(n_dev), bucket_sizes=tuple(bucket_sizes), total=total,
             padded_total=padded_total,
             shard_size=padded_total // int(n_dev),
-            wire_dtype=wire_dtype, block=int(block))
+            wire_dtype=wire_dtype, block=int(block),
+            ici=ici if hier else int(n_dev), dcn=dcn if hier else 1,
+            quantize_dcn=bool(quantize_dcn))
 
     def signature(self) -> str:
         """Content hash of everything that changes the step's program or
         the checkpointed sharded-state layout."""
         h = hashlib.sha256(repr((
             self.shapes, self.dtypes, self.n_dev, self.bucket_sizes,
-            self.wire_dtype, self.block)).encode())
+            self.wire_dtype, self.block, self.ici, self.dcn,
+            self.quantize_dcn)).encode())
         return h.hexdigest()[:16]
+
+    # -- hierarchy -----------------------------------------------------------
+    @property
+    def hierarchical(self) -> bool:
+        return self.dcn > 1
+
+    @property
+    def resid_elems(self) -> int:
+        """Per-replica error-feedback residual length. The classic wire
+        quantizes whole buckets (flat domain, ``padded_total``); the
+        DCN-only quantized hierarchy quantizes the post-ICI
+        ``bucket/ici`` chunks, so the residual lives on the chunk domain
+        (``padded_total/ici``)."""
+        if (self.hierarchical and self.quantize_dcn
+                and self.wire_dtype != "f32"):
+            return self.padded_total // self.ici
+        return self.padded_total
+
+    def chunk_sizes(self) -> Tuple[int, ...]:
+        """Per-bucket post-ICI chunk lengths (``bucket/ici``) — the DCN
+        operand sizes, and the bucket boundaries of the chunk-domain
+        residual."""
+        return tuple(b // self.ici for b in self.bucket_sizes)
+
+    def chunk_buckets(self, chunk_flat) -> List:
+        """Chunk-domain flat vector (``padded_total/ici``) -> per-bucket
+        chunk slices (residual bookkeeping for the DCN-only wire)."""
+        out, off = [], 0
+        for c in self.chunk_sizes():
+            out.append(chunk_flat[off:off + c])
+            off += c
+        return out
+
+    def device_perm(self) -> np.ndarray:
+        """``perm[k]`` = the scattered-order chunk index device ``k``
+        owns after the two-level reduce-scatter: ``σ(k) = (k % ici) * dcn
+        + k // ici``. Identity without hierarchy."""
+        k = np.arange(self.n_dev)
+        if not self.hierarchical:
+            return k
+        return (k % self.ici) * self.dcn + k // self.ici
+
+    def to_device_scattered_np(self, flat: np.ndarray) -> np.ndarray:
+        """Flat order -> device-major scattered order (row ``k`` = chunk
+        ``σ(k)``) — the layout sharded optimizer state is stored in, so
+        ``P(axis)`` places each device's own chunk. Equals
+        :meth:`to_scattered_np` bit-for-bit without hierarchy."""
+        rows = self.to_scattered_np(flat).reshape(self.n_dev,
+                                                  self.shard_size)
+        return rows[self.device_perm()].reshape(-1)
+
+    def from_device_scattered_np(self, scat: np.ndarray) -> np.ndarray:
+        rows = np.asarray(scat).reshape(self.n_dev, self.shard_size)
+        inv = np.argsort(self.device_perm())
+        return self.from_scattered_np(rows[inv].reshape(-1))
 
     # -- flat order ----------------------------------------------------------
     def flatten(self, tree):
@@ -380,21 +541,60 @@ class BucketLayout:
     # -- wire accounting -----------------------------------------------------
     def wire_bytes_per_step(self) -> int:
         """Gradient bytes one replica puts on the wire per step (the
-        reduce-scatter leg; the param all-gather is accounted separately).
-        int8 includes its per-block f32 scales."""
+        reduce-scatter/exchange legs; the param all-gather is accounted
+        separately). int8 includes its per-block f32 scales. For the
+        hierarchical wire this is the ICI + DCN leg total — the per-axis
+        split is :meth:`ici_wire_bytes_per_step` /
+        :meth:`dcn_wire_bytes_per_step`."""
+        if self.hierarchical:
+            return (self.ici_wire_bytes_per_step()
+                    + self.dcn_wire_bytes_per_step())
         per_elem = _WIRE_BYTES[self.wire_dtype]
         n = self.padded_total * per_elem
         if self.wire_dtype == "int8":
             n += (self.padded_total // self.block) * 4
         return n
 
+    def ici_wire_bytes_per_step(self) -> int:
+        """Bytes the ICI reduce-scatter leg moves per replica per step.
+        DCN-only quantization keeps this leg exact f32; the classic-wire
+        variant (``quantize_dcn=False``) quantizes before the ICI leg."""
+        if not self.hierarchical:
+            return 0
+        if self.wire_dtype == "f32" or self.quantize_dcn:
+            return self.padded_total * 4
+        n = self.padded_total * _WIRE_BYTES[self.wire_dtype]
+        if self.wire_dtype == "int8":
+            n += (self.padded_total // self.block) * 4
+        return n
+
+    def dcn_wire_bytes_per_step(self) -> int:
+        """Bytes the cross-host (DCN) exchange moves per replica per step
+        — the number the hierarchy exists to shrink: ``1/ici`` of what a
+        flat dp collective would push through the slow links (the
+        ``(hosts-1)/hosts`` ring factor applies to both alike and is
+        deliberately not modeled; operand bytes are the convention every
+        other leg accounts in)."""
+        if not self.hierarchical:
+            return 0
+        chunk_total = self.padded_total // self.ici
+        if self.wire_dtype == "f32" or not self.quantize_dcn:
+            return chunk_total * 4
+        n = chunk_total * _WIRE_BYTES[self.wire_dtype]
+        if self.wire_dtype == "int8":
+            n += (chunk_total // self.block) * 4
+        return n
+
     def grad_bytes_f32(self) -> int:
         return self.total * 4
 
 
-def build_layout(tree, n_dev: int, cfg: CommsConfig) -> BucketLayout:
+def build_layout(tree, n_dev: int, cfg: CommsConfig,
+                 ici: int = 1, dcn: int = 1) -> BucketLayout:
     return BucketLayout.build(tree, n_dev, cfg.effective_bucket_mb,
-                              wire_dtype=cfg.wire_dtype, block=cfg.block)
+                              wire_dtype=cfg.wire_dtype, block=cfg.block,
+                              ici=ici, dcn=dcn,
+                              quantize_dcn=cfg.quantize_dcn)
 
 
 # ---------------------------------------------------------------------------
@@ -543,6 +743,83 @@ def quantize_wire(x, wire_dtype: str, block: int):
 
 
 # ---------------------------------------------------------------------------
+# numpy host twins of the two-level wire (tests, tooling, and the contract
+# that the decomposition's MATH is checkable on any host — including ones
+# whose jaxlib lacks multiprocess CPU collectives, where the two-process
+# harness has to skip execution)
+# ---------------------------------------------------------------------------
+def group_sum_np(stacked: np.ndarray, groups) -> np.ndarray:
+    """Per-group sum of per-device rows, accumulated LINEARLY in group
+    participant order — the same association XLA's emulated collectives
+    use (verified bitwise by the tests), so these twins reproduce device
+    results bit for bit, not just approximately. Returns one summed row
+    per group, in group order."""
+    out = []
+    for g in groups:
+        s = np.asarray(stacked[g[0]], np.float32).copy()
+        for k in g[1:]:
+            s = s + np.asarray(stacked[k], np.float32)
+        out.append(s)
+    return np.stack(out)
+
+
+def _hier_groups(n_dev: int, ici: int, dcn: int):
+    ici_groups = [[h * ici + i for i in range(ici)] for h in range(dcn)]
+    dcn_groups = [[h * ici + i for h in range(dcn)] for i in range(ici)]
+    return ici_groups, dcn_groups
+
+
+def hier_reduce_scatter_np(stacked: np.ndarray, ici: int, dcn: int
+                           ) -> np.ndarray:
+    """Host twin of the two-level reduce-scatter over one bucket:
+    ``stacked`` is ``(n_dev, b)`` per-device values; returns ``(n_dev,
+    b/n_dev)`` — the unique global-sum shard each device holds (device
+    ``k = h*ici + i`` owns chunk ``σ(k) = i*dcn + h``), computed as ICI
+    reduce-scatter (host-linear partial sums) then DCN reduce-scatter
+    (linear across hosts)."""
+    n = ici * dcn
+    b = stacked.shape[1]
+    ici_groups, dcn_groups = _hier_groups(n, ici, dcn)
+    host = group_sum_np(stacked, ici_groups)          # (dcn, b)
+    chunks = np.zeros((n, b // ici), np.float32)
+    for h in range(dcn):
+        for i in range(ici):
+            chunks[h * ici + i] = host[h].reshape(ici, -1)[i]
+    shards = np.zeros((n, b // n), np.float32)
+    for gi, g in enumerate(dcn_groups):
+        s = group_sum_np(chunks, [g])[0]              # global chunk gi
+        for h, k in enumerate(g):
+            shards[k] = s.reshape(dcn, -1)[h]
+    return shards
+
+
+def hier_allreduce_np(stacked: np.ndarray, ici: int, dcn: int
+                      ) -> np.ndarray:
+    """Host twin of the two-level allreduce over one bucket: ICI
+    reduce-scatter, DCN allreduce of the chunks, ICI all-gather. Returns
+    ``(n_dev, b)`` — every device's reassembled global sum (identical
+    rows; kept per-device so tests can compare against each replica's
+    shard_map output)."""
+    n = ici * dcn
+    b = stacked.shape[1]
+    ici_groups, dcn_groups = _hier_groups(n, ici, dcn)
+    host = group_sum_np(stacked, ici_groups)          # (dcn, b)
+    chunks = np.zeros((n, b // ici), np.float32)
+    for h in range(dcn):
+        for i in range(ici):
+            chunks[h * ici + i] = host[h].reshape(ici, -1)[i]
+    summed = group_sum_np(chunks, dcn_groups)         # (ici, b/ici)
+    full = summed.reshape(-1)                         # flat order
+    return np.broadcast_to(full, (n, b)).copy()
+
+
+def hier_mean_np(stacked: np.ndarray, ici: int, dcn: int) -> np.ndarray:
+    """Two-level global MEAN of per-device values — the gradient the
+    unsharded hierarchical update applies. ``(n_dev, b) -> (b,)``."""
+    return hier_allreduce_np(stacked, ici, dcn)[0] / (ici * dcn)
+
+
+# ---------------------------------------------------------------------------
 # the plan — everything the traced step needs, all shapes static
 # ---------------------------------------------------------------------------
 class CommsPlan:
@@ -560,24 +837,44 @@ class CommsPlan:
         self.segplan: Optional[SegmentPlan] = (
             SegmentPlan.build(layout, cfg.segments) if cfg.overlap
             else None)
+        # two-level wire: replica groups for the ICI (intra-host) and DCN
+        # (cross-host) legs. A dcn==1 factorization (single host, or an
+        # interleaved device order the probe refused) collapses the plan
+        # onto the classic single-level wire — same program, same bits.
+        if layout.hierarchical:
+            self.ici_groups, self.dcn_groups = _hier_groups(
+                layout.n_dev, layout.ici, layout.dcn)
+        else:
+            self.ici_groups = self.dcn_groups = None
+
+    @property
+    def hierarchical(self) -> bool:
+        return self.layout.hierarchical
 
     # -- telemetry -----------------------------------------------------------
     def summary(self) -> Dict[str, Any]:
         lo, cfg = self.layout, self.cfg
         bucketed = cfg.effective_bucket_mb > 0
-        if bucketed:
+        n_b = len(lo.bucket_sizes)
+        if lo.hierarchical:
+            # per bucket: ICI reduce-scatter + DCN exchange (allreduce, or
+            # reduce-scatter under ZeRO-1) + (unsharded) ICI all-gather;
+            # the sharded update replaces the per-bucket gathers with the
+            # two-stage (DCN then ICI) param all-gather
+            collectives = (2 * n_b + 2 if cfg.sharded_update
+                           else 3 * n_b)
+        elif bucketed:
             # one reduce-scatter + one all-gather per bucket (the sharded
             # update folds the grad all-gather into the param all-gather)
-            collectives = (2 * len(lo.bucket_sizes)
-                           if not cfg.sharded_update
-                           else len(lo.bucket_sizes) + 1)
+            collectives = (2 * n_b if not cfg.sharded_update
+                           else n_b + 1)
         else:
             collectives = len(lo.sizes)      # one psum per grad leaf
-        return {
+        out = {
             "sharded_update": cfg.sharded_update,
             "wire_dtype": cfg.wire_dtype,
             "bucket_mb": cfg.effective_bucket_mb,
-            "buckets": len(lo.bucket_sizes) if bucketed else 0,
+            "buckets": n_b if bucketed else 0,
             "grad_leaves": len(lo.sizes),
             "collectives_per_step": collectives,
             "wire_bytes_per_step": lo.wire_bytes_per_step(),
@@ -587,6 +884,15 @@ class CommsPlan:
             "overlap": cfg.overlap,
             "segments": self.segplan.n_segments if self.segplan else 0,
         }
+        if cfg.hierarchy:
+            out["hierarchy"] = {
+                "ici_axis": lo.ici, "dcn_axis": lo.dcn,
+                "active": lo.hierarchical,
+                "quantize_dcn": lo.quantize_dcn,
+                "ici_wire_bytes_per_step": lo.ici_wire_bytes_per_step(),
+                "dcn_wire_bytes_per_step": lo.dcn_wire_bytes_per_step(),
+            }
+        return out
 
     # -- in-step collectives (per-replica view) ------------------------------
     def reduce_leafwise_mean(self, grads):
@@ -633,15 +939,19 @@ class CommsPlan:
             [C.all_gather(s, self.axis) for s in shards])
 
     def shard_of(self, flat, index):
-        """This replica's scattered-order slice of a flat-order vector.
+        """The shard replica ``index`` OWNS, sliced from a flat-order
+        vector: chunk ``index`` of every bucket on the flat wire, chunk
+        ``σ(index) = (index % ici) * dcn + index // ici`` on the
+        hierarchical wire (the chunk the two-level reduce-scatter lands
+        on device ``index``).
 
-        Scattered row ``i`` is by construction the concatenation of each
-        bucket's i-th chunk, so the shard is sliced per bucket directly
-        from the flat vector — never materializing the full
-        ``(padded_total,)`` scattered intermediate on every replica (a
-        param-sized transient per step that XLA cannot fold away because
-        ``index`` is traced)."""
+        Sliced per bucket directly from the flat vector — never
+        materializing the full ``(padded_total,)`` scattered intermediate
+        on every replica (a param-sized transient per step that XLA
+        cannot fold away because ``index`` is traced)."""
         lo = self.layout
+        if lo.hierarchical:
+            index = (index % lo.ici) * lo.dcn + index // lo.ici
         chunks, off = [], 0
         for b in lo.bucket_sizes:
             chunk = b // lo.n_dev
@@ -651,8 +961,126 @@ class CommsPlan:
         return jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
 
     def unscatter(self, gathered_scat):
-        """All-gathered scattered-order vector -> flat order."""
+        """All-gathered scattered-order vector -> flat order. The
+        hierarchical two-stage param gather (:meth:`hier_gather_params`)
+        lands in the SAME chunk-major order a flat-axis gather of
+        chunk-ordered shards does — position ``s`` of the two-stage
+        result is the shard of the device owning chunk ``s`` — so one
+        inverse serves both wires."""
         return self.layout.from_scattered(gathered_scat)
+
+    # -- hierarchical two-level wire (per-replica view) ----------------------
+    def hier_reduce(self, bucket_vals, resid_row):
+        """Two-level exchange of assembled buckets: reduce-scatter over
+        the ICI group (full bucket on the fast links -> per-replica
+        ``bucket/ici`` host-partial chunks), then the DCN leg over the
+        already-reduced chunks — reduce-scatter under ZeRO-1 (each
+        replica keeps its unique ``bucket/n_dev`` global shard),
+        allreduce otherwise (every replica of a host group holds the
+        full global chunk).
+
+        Quantization defaults to the DCN leg only
+        (``cfg.quantize_dcn``): the ICI leg reduces exact f32, the
+        cross-host operand carries bf16 (really riding the collective)
+        or block-scaled int8 (simulated wire, as on the classic path),
+        and the error-feedback residual ``resid_row`` lives on the chunk
+        domain. The classic-wire variant (``quantize_dcn=False``)
+        quantizes the buckets HERE, before the ICI leg — the caller only
+        adds its flat-domain residual to ``bucket_vals`` beforehand and
+        computes the new residual from the returned ``flat_wires``
+        (quantizing caller-side too would double-quantize the ICI leg).
+
+        Returns ``(out_list, new_resid_row, flat_wires)`` — per-bucket
+        global-sum shards (sharded) or chunks (unsharded); the updated
+        chunk-domain residual (DCN-only quantization, else None); and the
+        f32 wire values of the classic-wire variant for the caller's
+        flat-domain EF bookkeeping (None otherwise)."""
+        lo, cfg = self.layout, self.cfg
+        flat_wires = None
+        if cfg.quantized and not lo.quantize_dcn:
+            # classic wire shape under the two-level exchange: quantize
+            # the assembled buckets (flat-domain residual already added
+            # by the caller) before the ICI leg; bf16 genuinely rides
+            # the ICI collective, the DCN leg then moves f32 host sums
+            if cfg.wire_dtype == "bf16":
+                w16 = [b.astype(jnp.bfloat16) for b in bucket_vals]
+                flat_wires = [w.astype(jnp.float32) for w in w16]
+                ici_in = w16
+            else:
+                flat_wires = [quantize_wire(b, cfg.wire_dtype, cfg.block)
+                              for b in bucket_vals]
+                ici_in = flat_wires
+        else:
+            ici_in = bucket_vals
+        ici_chunks = [C.reduce_scatter(b, self.axis,
+                                       axis_index_groups=self.ici_groups)
+                      for b in ici_in]
+        if flat_wires is not None and cfg.wire_dtype == "bf16":
+            ici_chunks = [c.astype(jnp.float32) for c in ici_chunks]
+        new_resid_row = None
+        if cfg.quantized and lo.quantize_dcn:
+            pre = (ici_chunks if resid_row is None else
+                   [c + r for c, r in zip(ici_chunks,
+                                          lo.chunk_buckets(resid_row))])
+            if cfg.wire_dtype == "bf16":
+                dcn_in = [p.astype(jnp.bfloat16) for p in pre]
+                wires = [w.astype(jnp.float32) for w in dcn_in]
+            else:
+                wires = [quantize_wire(p, cfg.wire_dtype, cfg.block)
+                         for p in pre]
+                dcn_in = wires
+            if resid_row is not None:
+                new_resid_row = jnp.concatenate(
+                    [p - w for p, w in zip(pre, wires)])
+        else:
+            dcn_in = ici_chunks
+        quant_dcn = dcn_in is not ici_chunks and cfg.wire_dtype == "bf16"
+        if cfg.sharded_update:
+            out = [C.reduce_scatter(c, self.axis,
+                                    axis_index_groups=self.dcn_groups)
+                   for c in dcn_in]
+        else:
+            out = [lax.psum(c, self.axis,
+                            axis_index_groups=self.dcn_groups)
+                   for c in dcn_in]
+        if quant_dcn:
+            out = [o.astype(jnp.float32) for o in out]
+        return out, new_resid_row, flat_wires
+
+    def hier_unique_shards(self, chunks, index):
+        """Unsharded hierarchical update: slice each replica's UNIQUE
+        sub-chunk (``h = index // ici``) out of the DCN-allreduced
+        global chunks, so the norm-clip scale is computed from exactly
+        the same unique-ownership pieces — same values, same association
+        — the ZeRO-1 path reduces over; sharding can't move the clip
+        threshold by an ulp."""
+        lo = self.layout
+        h = index // lo.ici
+        out = []
+        for c, b in zip(chunks, lo.bucket_sizes):
+            sub = b // lo.n_dev
+            out.append(lax.dynamic_slice(c, (h * sub,), (sub,)))
+        return out
+
+    def hier_gather_buckets(self, chunks) -> Any:
+        """DCN-allreduced per-bucket global chunks -> full flat summed
+        vector: one ICI all-gather per bucket (tiled group gather inverts
+        the tiled ICI scatter, so flat order falls straight out)."""
+        return self.layout.unbuckets(
+            [C.all_gather(c, self.axis,
+                          axis_index_groups=self.ici_groups)
+             for c in chunks])
+
+    def hier_gather_params(self, shard):
+        """ZeRO-1 param all-gather on the two-level wire: gather the
+        updated ``padded/n_dev`` shards across hosts first (DCN moves
+        only ``1/n_dev`` per peer), then across the host group over ICI.
+        The result is the chunk-major scattered order — feed
+        :meth:`unscatter`."""
+        g1 = C.all_gather(shard, self.axis,
+                          axis_index_groups=self.dcn_groups)
+        return C.all_gather(g1, self.axis,
+                            axis_index_groups=self.ici_groups)
 
     # -- sharded optimizer state conversion (host side) ----------------------
     def _is_moment(self, leaf) -> bool:
@@ -660,14 +1088,16 @@ class CommsPlan:
                 and leaf.shape[0] == self.layout.padded_total)
 
     def opt_flat_to_tree(self, flat_state):
-        """Sharded-run optimizer state (moment leaves are scattered-order
-        ``(padded_total,)`` vectors) -> the tree form ``tx.init(params)``
-        would produce — the one checkpoint format, readable by sharded and
-        unsharded runs alike. Padding slots carry zeros (zero grads keep
-        zero moments), so the conversion is lossless."""
+        """Sharded-run optimizer state (moment leaves are device-major
+        scattered ``(padded_total,)`` vectors — chunk-major on the flat
+        wire, where the orders coincide) -> the tree form
+        ``tx.init(params)`` would produce — the one checkpoint format,
+        readable by sharded and unsharded runs alike, whichever wire
+        wrote it. Padding slots carry zeros (zero grads keep zero
+        moments), so the conversion is lossless."""
         return jax.tree.map(
             lambda l: self.layout.unflatten_np(
-                self.layout.from_scattered_np(np.asarray(l)))
+                self.layout.from_device_scattered_np(np.asarray(l)))
             if self._is_moment(l) else l, flat_state)
 
     def opt_tree_to_flat(self, tree_state, flat_template):
@@ -675,7 +1105,7 @@ class CommsPlan:
         ``tx.init(flat_params)`` — its structure tells which positions are
         flattened moments vs pass-through scalars."""
         return jax.tree.map(
-            lambda tmpl, node: self.layout.to_scattered_np(
+            lambda tmpl, node: self.layout.to_device_scattered_np(
                 self.layout.flatten_np(node))
             if self._is_moment(tmpl) else node,
             flat_template, tree_state)

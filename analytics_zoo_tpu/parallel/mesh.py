@@ -117,3 +117,55 @@ def batch_divisor(mesh: Mesh) -> int:
     """Global batch must be a multiple of this (the TPU analogue of the
     reference's node_num*core_num rule, pyzoo/zoo/tfpark/tf_dataset.py:135-149)."""
     return mesh_axis_size(mesh, "dp") * mesh_axis_size(mesh, "fsdp")
+
+
+def dp_topology(mesh: Mesh, axis: str = "dp",
+                dcn_override: Optional[int] = None) -> Tuple[int, int]:
+    """Factor the data-parallel axis into ``(dcn, ici)`` sub-axes — the
+    two-level wire the hierarchical comms plane reduces over
+    (parallel/comms.py): fast intra-host links (ICI) inside each group of
+    ``ici`` consecutive devices, slow cross-host links (DCN) between the
+    ``dcn`` groups.
+
+    The factorization comes from device process locality: when the
+    devices along ``axis`` are *process-contiguous* (every process
+    contributes one equal-sized consecutive block — what
+    ``mesh_utils``/multihost init produce for a pure-dp mesh), ``dcn`` is
+    the process count and ``ici`` the per-process device count. A
+    single-process mesh (the 8-device simulated CPU slice) has no real
+    host boundary, so it factors ``(1, n)`` unless ``dcn_override``
+    (``ZOO_COMMS_DCN_AXIS`` / config ``comms_dcn_axis``) imposes a
+    simulated split — the knob the tier-1 mesh uses to stand in for a
+    2-host pod.
+
+    An interleaved device order (process boundaries not contiguous along
+    ``axis``) cannot host the two-level wire — a "host group" would span
+    DCN — so it deliberately degrades to ``(1, n)`` rather than build
+    groups that are hierarchical in name only.
+    """
+    n = mesh_axis_size(mesh, axis)
+    if dcn_override is not None and int(dcn_override) > 0:
+        dcn = int(dcn_override)
+        if n % dcn != 0:
+            raise ValueError(
+                f"comms_dcn_axis={dcn} does not divide the {axis} axis "
+                f"size {n}")
+        return dcn, n // dcn
+    if axis not in mesh.shape:
+        return 1, n
+    # devices laid out along `axis`, everything else collapsed: for the
+    # pure-dp meshes the comms plane owns, this is just the flat order
+    axes = list(mesh.axis_names)
+    dev = np.moveaxis(mesh.devices, axes.index(axis), 0)
+    dev = dev.reshape(n, -1)
+    procs = [getattr(d, "process_index", 0) for d in dev[:, 0]]
+    nproc = len(set(procs))
+    if nproc <= 1 or n % nproc != 0:
+        return 1, n
+    ici = n // nproc
+    blocks = [procs[h * ici:(h + 1) * ici] for h in range(nproc)]
+    contiguous = (all(len(set(b)) == 1 for b in blocks)
+                  and len({b[0] for b in blocks}) == nproc)
+    if not contiguous:
+        return 1, n
+    return nproc, ici

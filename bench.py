@@ -1140,6 +1140,7 @@ def _comms_child(smoke: bool) -> dict:
 
     from analytics_zoo_tpu.analysis.hlo_lint import (HloLinter,
                                                      collective_counts,
+                                                     collectives_by_axis,
                                                      parse_collectives)
 
     def run(cfg, **kw):
@@ -1158,8 +1159,14 @@ def _comms_child(smoke: bool) -> dict:
         declared = est.engine.comms_snapshot()
         # the hlo_lint accounting rule, run right here: measured launches
         # and reduce-scatter wire bytes vs what the plane declares
+        # (per-axis under the hierarchical wire)
         accounting_ok = not HloLinter().lint_text(
             text, label="bench:train", declared=declared)
+        by_axis = None
+        lo = est.engine.comms.layout if est.engine.comms else None
+        if lo is not None and lo.hierarchical:
+            by_axis = collectives_by_axis(parse_collectives(text),
+                                          lo.ici, lo.dcn)
         # warm the executable with one rolled-back step so the timed fit
         # measures steady-state step rate, not each leg's JIT compile
         # (the snapshot copies survive the step's buffer donation)
@@ -1176,7 +1183,8 @@ def _comms_child(smoke: bool) -> dict:
              jax.tree_util.tree_leaves(est.engine.params)])
         return {"losses": [s["train_loss"] for s in stats],
                 "weights": weights, "collectives": collectives,
-                "by_kind": by_kind, "accounting_verified": accounting_ok,
+                "by_kind": by_kind, "by_axis": by_axis,
+                "accounting_verified": accounting_ok,
                 "fit_s": dt,
                 "steps_per_s": round(snap.get("steps", 0) / max(dt, 1e-9),
                                      1),
@@ -1198,6 +1206,20 @@ def _comms_child(smoke: bool) -> dict:
     sharded_small = run({"grad_bucket_mb": 0.016}, sharded_update=True)
     overlapped = run({"grad_bucket_mb": 0.016, "comms_overlap": True},
                      sharded_update=True)
+    # hierarchical leg (PR 12): the SAME multi-bucket ZeRO-1 layout on
+    # the two-level ICI x DCN wire, dp factored as 2 simulated hosts x 4
+    # chips. Per-axis launches/bytes come from the replica-group shapes
+    # in the lowered program; the DCN byte gate is the hierarchy's whole
+    # point (cross-host bytes <= flat wire bytes / host_count). The
+    # bit-identity family holds WITHIN the two-level wire (vs the
+    # overlapped-hierarchical leg below); vs the flat wire it differs at
+    # reduction-association level (documented in parallel/comms.py), so
+    # hier_vs_flat_drift is reported, not gated to zero.
+    hier = run({"grad_bucket_mb": 0.016, "comms_hierarchy": True,
+                "comms_dcn_axis": 2}, sharded_update=True)
+    hier_overlap = run({"grad_bucket_mb": 0.016, "comms_hierarchy": True,
+                        "comms_dcn_axis": 2, "comms_overlap": True},
+                       sharded_update=True)
 
     reduction = flat["collectives"] / max(bucketed["collectives"], 1)
     wire = bf16["comms"]
@@ -1263,6 +1285,38 @@ def _comms_child(smoke: bool) -> dict:
         "stall_hidden_s": round(stall_hidden, 3),
         "dp": 8, "model_depth": depth, "model_width": width,
     }
+    hsnap = hier["comms"].get("hierarchy", {})
+    hax = hier["by_axis"] or {}
+    out.update({
+        # hierarchical leg (PR 12)
+        "hierarchical_bit_identical": bool(
+            hier["losses"] == hier_overlap["losses"]
+            and (hier["weights"] == hier_overlap["weights"]).all()),
+        "hierarchical_accounting_verified": hier["accounting_verified"],
+        "hierarchical_overlap_accounting_verified":
+            hier_overlap["accounting_verified"],
+        "hierarchical_ici_axis": hsnap.get("ici_axis"),
+        "hierarchical_dcn_axis": hsnap.get("dcn_axis"),
+        "hierarchical_buckets": hier["comms"].get("buckets"),
+        "hierarchical_rs_ici_launches": hax.get("ici", {}).get(
+            "reduce_scatter", 0),
+        "hierarchical_rs_dcn_launches": hax.get("dcn", {}).get(
+            "reduce_scatter", 0),
+        "hierarchical_ici_wire_bytes": hax.get("ici_wire_bytes"),
+        "hierarchical_dcn_wire_bytes": hax.get("dcn_wire_bytes"),
+        # the gate: cross-host bytes at most flat-wire bytes / host count
+        # (the flat dp wire for this layout moves the ICI leg's f32
+        # bytes, padded_total x 4)
+        "hierarchical_dcn_shrink_ok": bool(
+            hax.get("dcn_wire_bytes", 1 << 60) * hsnap.get("dcn_axis", 2)
+            <= hax.get("ici_wire_bytes", 0)),
+        "hier_vs_flat_drift": float(np.abs(
+            hier["weights"] - sharded_small["weights"]).max()),
+        "hierarchical_ge_sharded": bool(
+            hier["steps_per_s"] >= 0.9 * sharded_small["steps_per_s"]),
+    })
+    out["steps_per_s"]["hierarchical"] = hier["steps_per_s"]
+    out["steps_per_s"]["hierarchical_overlap"] = hier_overlap["steps_per_s"]
     return out
 
 
@@ -1278,10 +1332,14 @@ def bench_comms(smoke: bool) -> dict:
     pays one rolled-back warmup step so the timed window is steady-state.
     CI gates on: bucketed bit-identical to flat psum, >=2x fewer
     collective launches, >=1.9x fewer grad wire bytes with bf16, sharded
-    update bit-identical, and the overlapped leg bit-identical with
+    update bit-identical, the overlapped leg bit-identical with
     per-bucket launch counts, byte-for-byte wire parity and verified
-    hlo_lint accounting (.github/workflows/tier1.yml). ``stall_hidden_s``
-    and ``overlapped_ge_sharded`` report the steps/s gate vs the sharded
+    hlo_lint accounting, and the hierarchical leg (PR 12: two-level
+    ICI x DCN wire on a simulated 2-host x 4-chip factorization)
+    bit-identical within its family with per-axis accounting verified
+    and DCN wire bytes <= flat wire bytes / host_count
+    (.github/workflows/tier1.yml). ``stall_hidden_s`` and
+    ``overlapped_ge_sharded`` report the steps/s gate vs the sharded
     leg (soft on the sequential CPU-sim mesh, where async overlap cannot
     exist; the structural contract is the portable truth).
     """
@@ -1296,7 +1354,8 @@ def bench_comms(smoke: bool) -> dict:
     for knob in ("ZOO_GRAD_BUCKET_MB", "ZOO_SHARDED_UPDATE",
                  "ZOO_ALLREDUCE_DTYPE", "ZOO_ALLREDUCE_BLOCK",
                  "ZOO_COMMS_PLANE", "ZOO_COMMS_OVERLAP",
-                 "ZOO_COMMS_SEGMENTS"):
+                 "ZOO_COMMS_SEGMENTS", "ZOO_COMMS_HIERARCHY",
+                 "ZOO_COMMS_DCN_AXIS", "ZOO_COMMS_QUANTIZE_DCN"):
         env.pop(knob, None)
     # force the count — an ambient =4 from the caller's shell would run the
     # mesh at dp=4 while the output and the tier1 gate assume dp=8

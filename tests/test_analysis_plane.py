@@ -571,3 +571,163 @@ def test_knobs_markdown_table_covers_registry():
     table = knobs.markdown_table()
     for name in knobs.REGISTRY:
         assert f"`{name}`" in table
+
+
+# ---------------------------------------------------------------------------
+# PR 12: per-axis accounting + hierarchical / multihost goldens
+# ---------------------------------------------------------------------------
+def test_parse_collectives_group_shapes():
+    """Replica-group shapes come out of both attribute formats — the
+    stablehlo dense tensor and the HLO-text brace form — and classify
+    ICI vs DCN vs global legs."""
+    import textwrap
+
+    from analytics_zoo_tpu.analysis.hlo_lint import collectives_by_axis
+
+    mod = textwrap.dedent("""\
+        module @jit_step {
+          func.func public @main(%arg0: tensor<64xf32>) -> tensor<64xf32> {
+            %0 = "stablehlo.reduce_scatter"(%arg0) <{replica_groups = dense<[[0, 1, 2, 3], [4, 5, 6, 7]]> : tensor<2x4xi64>, scatter_dimension = 0 : i64}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              %s = stablehlo.add %a, %b : tensor<f32>
+              stablehlo.return %s : tensor<f32>
+            }) : (tensor<64xf32>) -> tensor<16xf32>
+            %1 = "stablehlo.all_reduce"(%0) <{replica_groups = dense<[[0, 4], [1, 5], [2, 6], [3, 7]]> : tensor<4x2xi64>}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              %s = stablehlo.add %a, %b : tensor<f32>
+              stablehlo.return %s : tensor<f32>
+            }) : (tensor<16xf32>) -> tensor<16xf32>
+            %2 = "stablehlo.all_gather"(%1) <{all_gather_dim = 0 : i64, replica_groups = dense<[[0, 1, 2, 3], [4, 5, 6, 7]]> : tensor<2x4xi64>}> : (tensor<16xf32>) -> tensor<64xf32>
+            %3 = "stablehlo.all_reduce"(%2) <{replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              %s = stablehlo.add %a, %b : tensor<f32>
+              stablehlo.return %s : tensor<f32>
+            }) : (tensor<64xf32>) -> tensor<64xf32>
+            return %3 : tensor<64xf32>
+          }
+        }
+        """)
+    ops = parse_collectives(mod)
+    assert [op.group_shape for op in ops] == [(2, 4), (4, 2), (2, 4),
+                                              (1, 8)]
+    ax = collectives_by_axis(ops, 4, 2)
+    assert ax["ici"] == {"reduce_scatter": 1, "all_gather": 1}
+    assert ax["dcn"] == {"all_reduce": 1}
+    assert ax["global"] == {"all_reduce": 1}
+    assert ax["ici_wire_bytes"] == 64 * 4
+    assert ax["dcn_wire_bytes"] == 16 * 4
+    # HLO-text brace form (post-compile text, async start op)
+    hlo = ('%rs = f32[16] reduce-scatter-start(f32[64] %p), '
+           'replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, '
+           'to_apply=%add : (tensor<64xf32>) -> tensor<16xf32>')
+    ops2 = parse_collectives(hlo)
+    assert len(ops2) == 1 and ops2[0].group_shape == (2, 4)
+
+
+def test_hierarchical_golden_leg_contract():
+    """The committed hierarchical contract: per-axis launch counts (one
+    ICI reduce-scatter + one DCN reduce-scatter per bucket under ZeRO-1,
+    the two-stage param all-gather) and the DCN shrink pin."""
+    contracts = golden_mod.load_goldens()
+    entry = contracts["hierarchical"]
+    hier = entry["declared"]["hierarchy"]
+    assert (hier["ici_axis"], hier["dcn_axis"]) == (4, 2)
+    buckets = entry["declared"]["buckets"]
+    assert buckets >= 2
+    assert entry["by_axis"]["ici"]["reduce_scatter"] == buckets
+    assert entry["by_axis"]["dcn"]["reduce_scatter"] == buckets
+    assert entry["by_axis"]["ici"]["all_gather"] == 1
+    assert entry["by_axis"]["dcn"]["all_gather"] == 1
+    assert entry["accounting_verified"] is True
+    assert entry["dcn_wire_bytes"] * 4 == entry["ici_wire_bytes"]
+    assert contracts["hierarchical_dcn_shrink_ok"] is True
+
+
+def test_golden_gate_fails_on_dcn_byte_regression():
+    """Moving gradient bytes onto the cross-host links must fail the
+    gate even when total launches/bytes stay plausible."""
+    contracts = golden_mod.load_goldens()
+    tampered = json.loads(json.dumps(contracts))      # deep copy
+    tampered["hierarchical"]["dcn_wire_bytes"] *= 4
+    tampered["hierarchical"]["by_axis"]["dcn"]["reduce_scatter"] += 1
+    ok, delta = golden_mod.check(measured=tampered)
+    assert not ok
+    joined = "\n".join(delta)
+    assert "hierarchical.dcn_wire_bytes" in joined
+    assert "hierarchical.by_axis.dcn.reduce_scatter" in joined
+
+
+def test_multihost_golden_matches_simulated_capture(orca_context):
+    """The committed multihost contract regenerates exactly on the
+    single-process simulated mesh (the program depends only on the
+    (n_dev, dcn, ici) factorization) — so the contract is enforced
+    everywhere, and the two-process harness additionally proves the
+    real topology lowers to the same program."""
+    measured = golden_mod.capture_multihost_contract(dcn=2)
+    ok, delta = golden_mod.check_multihost(measured)
+    assert ok, "multihost contract drifted:\n" + "\n".join(delta)
+    assert measured["accounting_verified"] is True
+    assert measured["dcn_wire_bytes"] == measured["declared_dcn_wire_bytes"]
+
+
+def test_accounting_hier_ici_eq_dcn_checks_kinds_and_bytes():
+    """ici == dcn meshes: group shapes coincide, but collective kinds and
+    combined wire bytes are still verified — a byte regression on the
+    grouped legs cannot pass as 'ambiguous'."""
+    import textwrap
+
+    mod = textwrap.dedent("""\
+        module @jit_step {
+          func.func public @main(%arg0: tensor<64xf32>) -> tensor<64xf32> {
+            %0 = "stablehlo.reduce_scatter"(%arg0) <{replica_groups = dense<[[0, 1], [2, 3]]> : tensor<2x2xi64>, scatter_dimension = 0 : i64}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              %s = stablehlo.add %a, %b : tensor<f32>
+              stablehlo.return %s : tensor<f32>
+            }) : (tensor<64xf32>) -> tensor<32xf32>
+            %1 = "stablehlo.all_reduce"(%0) <{replica_groups = dense<[[0, 2], [1, 3]]> : tensor<2x2xi64>}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              %s = stablehlo.add %a, %b : tensor<f32>
+              stablehlo.return %s : tensor<f32>
+            }) : (tensor<32xf32>) -> tensor<32xf32>
+            %2 = "stablehlo.all_gather"(%1) <{all_gather_dim = 0 : i64, replica_groups = dense<[[0, 1], [2, 3]]> : tensor<2x2xi64>}> : (tensor<32xf32>) -> tensor<64xf32>
+            %3 = "stablehlo.all_reduce"(%2) <{replica_groups = dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              %s = stablehlo.add %a, %b : tensor<f32>
+              stablehlo.return %s : tensor<f32>
+            }) : (tensor<64xf32>) -> tensor<64xf32>
+            return %3 : tensor<64xf32>
+          }
+        }
+        """)
+    declared = {"buckets": 1, "sharded_update": False, "wire_dtype": "f32",
+                "grad_leaves": 3, "collectives_per_step": 3,
+                "wire_bytes_per_step": 64 * 4 + 32 * 4,
+                "hierarchy": {"active": True, "ici_axis": 2, "dcn_axis": 2,
+                              "quantize_dcn": True,
+                              "ici_wire_bytes_per_step": 64 * 4,
+                              "dcn_wire_bytes_per_step": 32 * 4}}
+    linter = HloLinter()
+    assert not linter.lint_text(mod, label="train", declared=declared)
+    # combined grouped bytes drift -> caught even without a per-leg split
+    bad = json.loads(json.dumps(declared))
+    bad["hierarchy"]["dcn_wire_bytes_per_step"] += 64
+    found = linter.lint_text(mod, label="train", declared=bad)
+    assert found and any("ici==dcn" in f.message for f in found)
+    # a lost param all-gather is caught by kind
+    bad2 = mod.replace("all_gather", "all_gather_DISABLED")
+    found2 = linter.lint_text(bad2, label="train", declared=declared)
+    assert found2 and any("all-gather" in f.message for f in found2)
+
+
+def test_hier_capture_on_ici_eq_dcn_mesh_verifies(orca_context):
+    """The placement-free multihost capture on a 4-device (2-host x
+    2-chip) submesh — the ici==dcn case end-to-end through the real
+    lowered program."""
+    import jax as _jax
+
+    from analytics_zoo_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"dp": -1}, devices=_jax.devices()[:4])
+    contract = golden_mod.capture_multihost_contract(mesh, dcn=2)
+    assert (contract["ici_axis"], contract["dcn_axis"]) == (2, 2)
+    assert contract["accounting_verified"] is True, contract

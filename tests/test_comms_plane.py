@@ -686,3 +686,367 @@ def test_overlapped_rs_spans_in_perfetto_timeline(orca_context):
     trace.clear()
     _fit({"grad_bucket_mb": 0.001, "comms_overlap": True}, epochs=1)
     assert not trace.spans()
+
+
+# ---------------------------------------------------------------------------
+# PR 12: pod-scale hierarchical comms — ICI reduce-scatter x DCN exchange
+# ---------------------------------------------------------------------------
+def _hier_cfg(dcn=2, **extra):
+    return {"grad_bucket_mb": 0.001, "comms_hierarchy": True,
+            "comms_dcn_axis": dcn, **extra}
+
+
+def test_hier_layout_alignment_and_device_order(orca_context):
+    """Host-boundary rule: every bucket splits into whole host chunks
+    (and, for the int8 DCN wire, the chunk into whole scale blocks); the
+    device-major scattered order (sigma-permuted) round-trips bit-exactly
+    and collapses onto chunk-major without hierarchy."""
+    tree = _random_tree()
+    cfg = CommsConfig(bucket_mb=0.0005, hierarchy=True, dcn_size=2)
+    lo = build_layout(tree, 8, cfg, ici=4, dcn=2)
+    assert lo.hierarchical and (lo.ici, lo.dcn) == (4, 2)
+    assert len(lo.bucket_sizes) > 1
+    assert all(b % 8 == 0 for b in lo.bucket_sizes)
+    # int8 DCN-only wire: the quantized bucket/ici chunk must split into
+    # whole scale blocks
+    lo8 = build_layout(tree, 8, CommsConfig(
+        bucket_mb=0.0005, wire_dtype="int8", block=64, hierarchy=True,
+        dcn_size=2), ici=4, dcn=2)
+    assert all(b % (4 * 64) == 0 for b in lo8.bucket_sizes)
+    assert lo8.resid_elems == lo8.padded_total // 4
+    # sigma = (k % ici) * dcn + k // ici, a permutation
+    perm = lo.device_perm()
+    assert sorted(perm.tolist()) == list(range(8))
+    assert perm[1] == 2 and perm[4] == 1      # (h,i)=(0,1)->2, (1,0)->1
+    flat = lo.flatten_np(tree)
+    dscat = lo.to_device_scattered_np(flat)
+    assert (lo.from_device_scattered_np(dscat) == flat).all()
+    # row k of the device-major order IS chunk sigma(k) of the chunk-major
+    rows_d = dscat.reshape(8, lo.shard_size)
+    rows_c = lo.to_scattered_np(flat).reshape(8, lo.shard_size)
+    assert all((rows_d[k] == rows_c[perm[k]]).all() for k in range(8))
+    # no hierarchy: identity (device-major == chunk-major bit for bit)
+    lo_flat = build_layout(tree, 8, CommsConfig(bucket_mb=0.0005))
+    assert (lo_flat.to_device_scattered_np(flat) ==
+            lo_flat.to_scattered_np(flat)).all()
+    # the hierarchy factors into the layout identity
+    assert lo.signature() != lo_flat.signature()
+
+
+def test_hier_topology_probe(orca_context):
+    """dp_topology factors from process locality: contiguous equal blocks
+    -> (nproc, n/nproc); interleaved or single-process -> (1, n);
+    override validated."""
+    from types import SimpleNamespace
+
+    from analytics_zoo_tpu.parallel.mesh import dp_topology
+
+    def mesh_of(procs):
+        devs = np.array([SimpleNamespace(process_index=p) for p in procs],
+                        dtype=object).reshape(len(procs), 1, 1, 1)
+        return SimpleNamespace(shape={"dp": len(procs), "fsdp": 1,
+                                      "tp": 1, "sp": 1},
+                               axis_names=("dp", "fsdp", "tp", "sp"),
+                               devices=devs)
+
+    assert dp_topology(mesh_of([0, 0, 0, 0, 1, 1, 1, 1])) == (2, 4)
+    assert dp_topology(mesh_of([0, 0, 1, 1, 2, 2, 3, 3])) == (4, 2)
+    # interleaved process order: a "host group" would span DCN — refuse
+    assert dp_topology(mesh_of([0, 1, 0, 1, 0, 1, 0, 1])) == (1, 8)
+    # single process: no host boundary
+    assert dp_topology(mesh_of([0] * 8)) == (1, 8)
+    # override wins (the simulated-mesh split) and is validated
+    assert dp_topology(mesh_of([0] * 8), dcn_override=2) == (2, 4)
+    with pytest.raises(ValueError):
+        dp_topology(mesh_of([0] * 8), dcn_override=3)
+    # the real 8-dev single-process mesh probes flat
+    assert dp_topology(orca_context.mesh) == (1, 8)
+
+
+def test_hier_numpy_twins_match_device_bitwise(orca_context):
+    """The decomposition's MATH, bit-exact against the device: the
+    two-level reduce-scatter / allreduce over a bucket equals the numpy
+    host twins (linear-in-group-order sums) bit for bit — which is what
+    makes the hierarchy checkable on hosts whose jaxlib lacks
+    multiprocess CPU collectives."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from analytics_zoo_tpu.parallel._compat import shard_map
+    from analytics_zoo_tpu.parallel.comms import (hier_allreduce_np,
+                                                  hier_mean_np,
+                                                  hier_reduce_scatter_np)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    rng = np.random.RandomState(3)
+    for ici, dcn in ((4, 2), (2, 4)):
+        b = 64
+        stacked = (rng.rand(8, b).astype(np.float32) - 0.5) * 3
+        tree = {"w": np.zeros(b, np.float32)}   # one bucket of exactly b
+        cfg = CommsConfig(bucket_mb=4.0, hierarchy=True, dcn_size=dcn)
+        lo = build_layout(tree, 8, cfg, ici=ici, dcn=dcn)
+        assert lo.bucket_sizes == (b,)
+        plan = CommsPlan(cfg, lo)
+
+        def rs_body(v):
+            out, _, _ = plan.hier_reduce([v[0]], None)
+            return out[0]
+
+        def ar_body(v):
+            out, _, _ = plan.hier_reduce([v[0]], None)
+            return plan.hier_gather_buckets(out)
+
+        rs = shard_map(rs_body, mesh=mesh, in_specs=(P("dp", None),),
+                       out_specs=P("dp"), check_vma=False)
+        # unsharded exchange (allreduce + ici gather)
+        ar = shard_map(ar_body, mesh=mesh, in_specs=(P("dp", None),),
+                       out_specs=P("dp"), check_vma=False)
+
+        cfg_sh = CommsConfig(bucket_mb=4.0, hierarchy=True, dcn_size=dcn,
+                             sharded_update=True)
+        plan_sh = CommsPlan(cfg_sh, build_layout(tree, 8, cfg_sh,
+                                                 ici=ici, dcn=dcn))
+
+        def rs_sh_body(v):
+            out, _, _ = plan_sh.hier_reduce([v[0]], None)
+            return out[0]
+
+        rs_sh = shard_map(rs_sh_body, mesh=mesh,
+                          in_specs=(P("dp", None),),
+                          out_specs=P("dp"), check_vma=False)
+
+        got_ar = np.asarray(jax.jit(ar)(stacked)).reshape(8, b)
+        assert (got_ar == hier_allreduce_np(stacked, ici, dcn)).all()
+        got_sh = np.asarray(jax.jit(rs_sh)(stacked)).reshape(8, b // 8)
+        assert (got_sh == hier_reduce_scatter_np(stacked, ici, dcn)).all()
+        # the allreduce twin / n is the mean the unsharded update applies
+        assert (hier_mean_np(stacked, ici, dcn) ==
+                hier_allreduce_np(stacked, ici, dcn)[0] / 8).all()
+        # unsharded chunks (pre-gather) also match the twin's chunk rows
+        got_rs = np.asarray(jax.jit(rs)(stacked)).reshape(8, b // ici)
+        full = hier_allreduce_np(stacked, ici, dcn)[0]
+        for h in range(dcn):
+            for i in range(ici):
+                want = full[i * (b // ici):(i + 1) * (b // ici)]
+                assert (got_rs[h * ici + i] == want).all()
+
+
+def test_hier_exact_sums_match_flat_bitwise(orca_context):
+    """When every partial sum is exactly representable (integer-valued
+    grads), the two-level association and the flat linear reduction agree
+    BITWISE — the flat == hierarchical contract, asserted where it is
+    mathematically meaningful (for generic floats the two associations
+    differ at last-ulp level, documented in parallel/comms.py)."""
+    from analytics_zoo_tpu.parallel.comms import (hier_allreduce_np,
+                                                  group_sum_np)
+
+    rng = np.random.RandomState(7)
+    stacked = rng.randint(-512, 512, (8, 64)).astype(np.float32)
+    flat_lin = group_sum_np(stacked, [list(range(8))])[0]
+    assert (hier_allreduce_np(stacked, 4, 2)[0] == flat_lin).all()
+    assert (hier_allreduce_np(stacked, 2, 4)[0] == flat_lin).all()
+
+
+def test_hier_bit_identity_family(orca_context):
+    """Within the two-level wire the whole PR-8/11 family holds:
+    single-bucket == multi-bucket == overlapped == ZeRO-1-sharded ==
+    scan-fused, bit-identical — and a dcn=1 factorization collapses
+    byte-for-byte onto the classic bucketed wire."""
+    data = _data()
+    lh, eh = _fit(_hier_cfg(), data=data)
+    l1, _ = _fit({"comms_hierarchy": True, "comms_dcn_axis": 2},
+                 data=data)                      # single default bucket
+    lo_, _ = _fit(_hier_cfg(comms_overlap=True), data=data)
+    ls, es = _fit(_hier_cfg(), data=data, sharded_update=True)
+    lf, _ = _fit(_hier_cfg(), data=data, fuse=2, sharded_update=True)
+    wh = _flat_params(eh)
+    assert lh == l1 == lo_ == ls == lf
+    assert (wh == _flat_params(es)).all()
+    assert eh.engine.comms.summary()["buckets"] > 1
+    hier = es.engine.comms.summary()["hierarchy"]
+    assert (hier["ici_axis"], hier["dcn_axis"]) == (4, 2)
+    # DCN moves 1/ici of the flat wire's bytes — the point of the plan
+    assert hier["dcn_wire_bytes_per_step"] * 4 == \
+        hier["ici_wire_bytes_per_step"]
+
+    # dcn=1: the hierarchical plan IS the classic bucketed program
+    lb, eb = _fit({"grad_bucket_mb": 0.001}, data=data)
+    ld1, ed1 = _fit(_hier_cfg(dcn=1), data=data)
+    assert ld1 == lb
+    assert (_flat_params(ed1) == _flat_params(eb)).all()
+    assert ed1.engine.comms.summary()["hierarchy"]["active"] is False
+    # ici=1 (one chip per host — dcn == dp) equally collapses: there are
+    # no fast links to pre-reduce on, and labelling the full axis "DCN"
+    # would misclassify the global loss/clip reductions
+    li1, ei1 = _fit(_hier_cfg(dcn=8), data=data)
+    assert li1 == lb
+    assert (_flat_params(ei1) == _flat_params(eb)).all()
+    assert ei1.engine.comms.summary()["hierarchy"]["active"] is False
+    assert not build_layout(_random_tree(), 8,
+                            CommsConfig(bucket_mb=0.001, hierarchy=True,
+                                        dcn_size=8),
+                            ici=1, dcn=8).hierarchical
+
+
+def test_hier_clipping_matches_between_update_modes(orca_context):
+    """The norm-clip scale comes from each replica's unique-ownership
+    pieces in BOTH hierarchical update modes, so ZeRO-1 cannot move the
+    clip threshold by an ulp."""
+    def clipped(shard):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1,
+                                   **_hier_cfg()},
+                           sharded_update=shard)
+        est.set_l2_norm_gradient_clipping(0.05)
+        stats = est.fit(dict(_data()), epochs=2, batch_size=32,
+                        verbose=False)
+        return [s["train_loss"] for s in stats], _flat_params(est)
+
+    lb, wb = clipped(False)
+    ls, ws = clipped(True)
+    assert lb == ls
+    assert (wb == ws).all()
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_hier_quantize_dcn_only_ef_drift(orca_context, wire):
+    """DCN-only quantization: the residual lives on the post-ICI chunk
+    domain (padded/ici per replica), sharded == unsharded stays
+    bit-identical, and error feedback bounds the drift vs the exact-f32
+    hierarchical wire."""
+    data = _data()
+    lf32, _ = _fit(_hier_cfg(), epochs=3, data=data)
+    lq, eq = _fit(_hier_cfg(allreduce_dtype=wire), epochs=3, data=data)
+    lqs, eqs = _fit(_hier_cfg(allreduce_dtype=wire), epochs=3, data=data,
+                    sharded_update=True)
+    assert lq == lqs
+    assert (_flat_params(eq) == _flat_params(eqs)).all()
+    lo = eq.engine.comms.layout
+    assert lo.resid_elems == lo.padded_total // lo.ici
+    assert eq.engine.comms_resid.shape == (8, lo.resid_elems)
+    drift = float(np.abs(np.asarray(lq) - np.asarray(lf32)).max())
+    assert drift < (5e-5 if wire == "bf16" else 5e-4), drift
+    # classic-wire variant: flat-domain residual, quantize before ICI
+    lqc, eqc = _fit(_hier_cfg(allreduce_dtype=wire,
+                              comms_quantize_dcn=False),
+                    epochs=3, data=data)
+    loc = eqc.engine.comms.layout
+    assert loc.resid_elems == loc.padded_total
+    driftc = float(np.abs(np.asarray(lqc) - np.asarray(lf32)).max())
+    assert driftc < (5e-5 if wire == "bf16" else 5e-4), driftc
+
+
+def test_hier_ckpt_round_trips(orca_context, tmp_path):
+    """Checkpoints stay wire-agnostic: a hierarchical ZeRO-1 run's state
+    is stored in canonical tree form (device-major scattered order
+    converted losslessly), restores bit-exactly into a hierarchical
+    continuation AND into a classic sharded run's representation."""
+    data = _data()
+    cfg = {**_hier_cfg(), "ckpt_async": False}
+    lref, eref = _fit(cfg, epochs=4, data=data, sharded_update=True)
+
+    l1, e1 = _fit(cfg, epochs=2, data=data, sharded_update=True)
+    d1 = str(tmp_path / "hier")
+    e1.save_checkpoint(d1, blocking=True)
+
+    # hier -> hier continuation lands on the uninterrupted run bit-exactly
+    e2 = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                      config={"steps_per_dispatch": 1, **cfg},
+                      sharded_update=True)
+    e2.load_checkpoint(d1)
+    l2 = [s["train_loss"] for s in
+          e2.fit(dict(data), epochs=2, batch_size=32, verbose=False,
+                 initial_epoch=2)]
+    assert l1 + l2 == lref
+    assert (_flat_params(e2) == _flat_params(eref)).all()
+
+    # the canonical tree form a hierarchical writer stores equals what a
+    # classic sharded engine restores from — same tree, no wire baked in
+    e3 = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                      config={"steps_per_dispatch": 1,
+                              "grad_bucket_mb": 0.001,
+                              "ckpt_async": False},
+                      sharded_update=True)
+    e3.load_checkpoint(d1)
+    assert e3.engine.step == e1.engine.step
+    assert (_flat_params(e3) == _flat_params(e1)).all()
+    # moment leaves re-scattered for the classic layout: converting both
+    # engines' opt state back to tree form must agree bit-for-bit
+    t1 = e1.engine.comms.opt_flat_to_tree(
+        jax.device_get(e1.engine.opt_state))
+    t3 = e3.engine.comms.opt_flat_to_tree(
+        jax.device_get(e3.engine.opt_state))
+    assert (_flat_tree(t1) == _flat_tree(t3)).all()
+    e1.shutdown()
+    e2.shutdown()
+    e3.shutdown()
+
+
+def test_hier_salts_compile_key(orca_context):
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    def key_for(cfg, **kw):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1, **cfg}, **kw)
+        it = data_to_iterator(dict(_data()), 32, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        batch = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in batch.x))
+        return est.engine.train_step_cache_key(batch)
+
+    k_classic = key_for({"grad_bucket_mb": 0.001})
+    k_hier = key_for(_hier_cfg())
+    k_hier2 = key_for(_hier_cfg())
+    k_dcn4 = key_for(_hier_cfg(dcn=4))
+    k_qdcn = key_for(_hier_cfg(allreduce_dtype="bf16"))
+    k_qclassic = key_for(_hier_cfg(allreduce_dtype="bf16",
+                                   comms_quantize_dcn=False))
+    assert k_hier == k_hier2              # same wire -> shared executable
+    assert len({k_classic, k_hier, k_dcn4, k_qdcn, k_qclassic}) == 5
+
+
+def test_hier_knob_resolution(orca_context, monkeypatch):
+    monkeypatch.setenv("ZOO_COMMS_HIERARCHY", "1")
+    monkeypatch.setenv("ZOO_COMMS_DCN_AXIS", "2")
+    cfg = CommsConfig.resolve({})
+    assert cfg.active and cfg.hierarchy and cfg.dcn_size == 2
+    assert cfg.quantize_dcn is True
+    assert cfg.effective_bucket_mb == CommsConfig.DEFAULT_BUCKET_MB
+    # config dict wins over env
+    cfg2 = CommsConfig.resolve({"comms_dcn_axis": 4,
+                                "comms_quantize_dcn": False})
+    assert cfg2.dcn_size == 4 and cfg2.quantize_dcn is False
+    monkeypatch.delenv("ZOO_COMMS_HIERARCHY")
+    monkeypatch.delenv("ZOO_COMMS_DCN_AXIS")
+    # the hierarchy knobs are program shape -> they salt the fingerprint
+    assert cfg.fingerprint() != CommsConfig.resolve(
+        {"grad_bucket_mb": 4.0}).fingerprint()
+    with pytest.raises(ValueError):
+        CommsConfig.resolve({"comms_dcn_axis": 2})  # dcn without hierarchy
+
+
+def test_hier_accounting_verified_and_tamper(orca_context):
+    """The per-axis hlo_lint cross-check passes on the real lowered
+    program and fails when the declared DCN accounting is tampered —
+    moving bytes onto the cross-host links cannot pass unnoticed."""
+    from analytics_zoo_tpu.analysis.hlo_lint import HloLinter
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                       config={"steps_per_dispatch": 1, **_hier_cfg()},
+                       sharded_update=True)
+    it = data_to_iterator(dict(_data()), 32, est.mesh, None, None,
+                          shuffle=False, config=est.config)
+    batch = next(it.epoch(shuffle=False, prefetch=False))
+    est.engine.build(tuple(np.asarray(a) for a in batch.x))
+    fn = est.engine.ensure_jit_train()
+    text = fn.lower(*est.engine.train_step_args(batch)).as_text()
+    declared = est.engine.comms_snapshot()
+    assert not HloLinter().lint_text(text, label="train",
+                                     declared=declared)
+    bad = dict(declared, hierarchy=dict(
+        declared["hierarchy"],
+        dcn_wire_bytes_per_step=declared["hierarchy"]
+        ["dcn_wire_bytes_per_step"] + 64))
+    findings = HloLinter().lint_text(text, label="train", declared=bad)
+    assert findings and any("DCN leg moves" in f.message
+                            for f in findings)

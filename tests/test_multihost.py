@@ -1,36 +1,33 @@
 """REAL multi-process multihost validation (round-1 weak #9: the
 jax.distributed path had no test and the dryrun was single-process).
 
-Two actual OS processes each with 2 virtual CPU devices run
+Two actual OS processes each with virtual CPU devices run
 ``init_orca_context("multihost", ...)`` against a shared coordinator,
-build the global 4-device mesh, assemble a global array from per-process
-shards, and run one jitted TrainEngine step — the full SPMD-controller
-contract of scripts/launch_multihost.sh, on localhost.
+build the global mesh, and exercise the SPMD-controller contract of
+scripts/launch_multihost.sh on localhost:
+
+* ``test_two_process_multihost`` — global-array assembly + one jitted
+  TrainEngine step whose gradients reduce across the process boundary
+  (skips on jaxlib builds without multiprocess CPU collectives).
+* ``test_multihost_golden_contract`` — the hierarchical comms plane's
+  program contract on the real 2-process topology: the ``(dcn, ici)``
+  factorization probed from process locality, cross-host launch counts
+  and DCN wire bytes diffed against ``tests/goldens/
+  multihost_contracts.json``. Lowering-only, so it runs even where the
+  execution test must skip.
+
+The worker-subprocess scaffolding (port allocation + bind-race retry,
+timeout kill, output surfacing) lives in ``tests/multihost_harness.py``.
 """
 
-import os
-import socket
-import subprocess
-import sys
+import json
 
 import pytest
 
-_WORKER = r'''
-import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-import jax
-jax.config.update("jax_platforms", "cpu")
-sys.path.insert(0, "__REPO__")
-import numpy as np
-import jax.numpy as jnp
-from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from multihost_harness import (NO_COLLECTIVES_SKIP, WORKER_PREAMBLE,
+                               run_workers)
 
-pid, port = int(sys.argv[1]), sys.argv[2]
-ctx = init_orca_context("multihost",
-                        coordinator_address="127.0.0.1:" + port,
-                        num_processes=2, process_id=pid)
-assert jax.process_count() == 2
+_WORKER = WORKER_PREAMBLE + r'''
 assert ctx.num_devices == 4
 
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -67,55 +64,93 @@ print("WORKER_OK %d %.5f" % (pid, loss))
 stop_orca_context()
 '''
 
+# golden worker: 4 virtual devices per process -> the (dcn=2, ici=4)
+# factorization the committed contract pins, PROBED from process
+# locality (dcn=0). Lowering only — no cross-process execution.
+_GOLDEN_WORKER = WORKER_PREAMBLE + r'''
+assert ctx.num_devices == 8
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from analytics_zoo_tpu.analysis.golden import capture_multihost_contract
+import json
+contract = capture_multihost_contract(ctx.mesh, dcn=0)
+if pid == 0:
+    print("MH_CONTRACT " + json.dumps(contract))
+print("WORKER_OK %d" % pid)
+stop_orca_context()
+'''
+
+
+# a lost free_port() race, in miniature: the first round's "coordinator"
+# reports the bind failure and dies, the retry round (fresh port) succeeds
+_BIND_RACE_WORKER = r'''
+import os, sys
+marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "first_try")
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    print("RuntimeError: Failed to bind to 127.0.0.1:%s — "
+          "Address already in use" % sys.argv[2])
+    sys.exit(1)
+print("WORKER_OK %s port %s" % (sys.argv[1], sys.argv[2]))
+'''
+
+
+def test_harness_retries_coordinator_bind_race_once(tmp_path):
+    """The free_port() port can be claimed between close and the
+    coordinator's own bind; the harness classifies that failure and
+    retries exactly once with a freshly drawn port."""
+    run = run_workers(_BIND_RACE_WORKER, tmp_path, timeout=30)
+    assert run.retried_bind
+    assert run.ok, run.tail()
+    # the retry really drew a new port: the workers report the one they
+    # were handed, and it is the run's recorded (second) port
+    assert all(f"port {run.port}" in out for out in run.outs)
 
 
 def test_two_process_multihost(tmp_path):
-    # bounded by the 150s communicate() timeout below
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER.replace("__REPO__", repo))
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = [subprocess.Popen([sys.executable, str(script), str(i),
-                               str(port)],
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, env=env, text=True)
-             for i in range(2)]
-    outs = []
-    timed_out = False
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=150)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            for q in procs:
-                q.kill()
-            out, _ = p.communicate()
-        outs.append(out)
-    if timed_out:
+    # bounded by the harness's 150s communicate() timeout
+    run = run_workers(_WORKER, tmp_path, devices_per_proc=2)
+    if run.timed_out:
         # surface whatever the workers DID print — a coordinator crash
         # leaves the other worker hanging and its own traceback is the clue
-        pytest.fail("multihost worker timed out; captured output:\n" +
-                    "\n---\n".join(o[-3000:] for o in outs))
-    if any("Multiprocess computations aren't implemented" in o
-           for o in outs):
-        # this jaxlib build has no cross-process CPU collectives (the
-        # gloo/mpi CPU collectives backend is compiled out): the 2-process
-        # init + global-mesh construction above DID succeed, but no jitted
-        # computation can span processes on this host. Environment
-        # limitation, not a repo bug — tracked as the pre-existing tier-1
-        # failure triaged in PR 2 (see CHANGES.md).
-        pytest.skip("jaxlib built without multiprocess CPU collectives")
+        pytest.fail("multihost worker timed out; captured output:\n"
+                    + run.tail())
+    if run.no_collectives:
+        pytest.skip(NO_COLLECTIVES_SKIP)
     losses = []
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc{i} failed:\n{out[-3000:]}"
+    for i, (rc, out) in enumerate(zip(run.returncodes, run.outs)):
+        assert rc == 0, f"proc{i} failed:\n{out[-3000:]}"
         assert f"WORKER_OK {i}" in out, out[-2000:]
         losses.append(float(out.split(f"WORKER_OK {i}")[1].split()[0]))
     # SPMD: both controllers must compute the identical global loss
     assert losses[0] == losses[1], losses
+
+
+def test_multihost_golden_contract(tmp_path):
+    """The first committed MULTIHOST program contract: two real
+    processes build the global 8-device mesh, the topology probe factors
+    dp into (dcn=2, ici=4) from process locality, and the hierarchical
+    train step's lowered per-axis launch counts + DCN wire bytes must
+    match tests/goldens/multihost_contracts.json field for field."""
+    from analytics_zoo_tpu.analysis.golden import check_multihost
+
+    run = run_workers(_GOLDEN_WORKER, tmp_path, devices_per_proc=4)
+    if run.timed_out:
+        pytest.fail("multihost golden worker timed out; captured "
+                    "output:\n" + run.tail())
+    if run.no_collectives and not run.ok:
+        # lowering needs no cross-process execution, so only an init-time
+        # failure on a collectives-free jaxlib justifies skipping
+        pytest.skip(NO_COLLECTIVES_SKIP)
+    for i, (rc, out) in enumerate(zip(run.returncodes, run.outs)):
+        assert rc == 0, f"proc{i} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out, out[-2000:]
+    line = [l for l in run.outs[0].splitlines()
+            if l.startswith("MH_CONTRACT ")]
+    assert line, run.outs[0][-2000:]
+    measured = json.loads(line[0][len("MH_CONTRACT "):])
+    assert measured["dcn_axis"] == 2 and measured["ici_axis"] == 4, (
+        "topology probe did not factor the 2-process mesh", measured)
+    ok, delta = check_multihost(measured)
+    assert ok, ("multihost golden contract drifted "
+                "(golden -> measured):\n  " + "\n  ".join(delta))

@@ -192,3 +192,56 @@ def test_featureset_from_tfrecords(tmp_path, orca_context):
     assert len(batches) == 300 // 64
     assert batches[0].x[0].shape == (64, 4)
     assert batches[0].y[0].shape == (64,)
+
+
+def test_disk_featureset_shard_stripe_reads_only_own_stripe(tmp_path,
+                                                            orca_context):
+    """stripe="shard" (PR 12 host-striped infeed): whole shard files go
+    to processes balanced on row counts, each (simulated) process opens
+    ONLY its own stripe's files, stripes are disjoint and cover the
+    dataset, and every process emits the same batch count."""
+    from analytics_zoo_tpu.feature.feature_set import DiskFeatureSet
+
+    cache = str(tmp_path / "stripe2")
+    n = 40
+    x = np.arange(n, dtype=np.float32)[:, None]
+    DiskFeatureSet.write({"x": x, "y": np.zeros(n, np.int32)}, cache,
+                         shard_size=7)          # ragged: 7,7,7,7,7,5
+
+    seen_rows, batch_counts, opened = [], [], []
+    for pid in range(2):
+        fs = DiskFeatureSet(cache, orca_context.mesh, batch_size=8,
+                            stripe="shard", _pid=pid, _nproc=2)
+        files = set()
+        orig = fs._mmap
+        fs._mmap = lambda s, kind, i: (files.add(s), orig(s, kind, i))[1]
+        rows = []
+        count = 0
+        for b in fs._host_batches(shuffle=False):
+            rows += list(np.asarray(b.x[0])[:, 0].astype(int))
+            count += 1
+        assert files == set(fs.shard_assignment[pid])
+        opened.append(files)
+        seen_rows.append(rows)
+        batch_counts.append(count)
+
+    assert opened[0].isdisjoint(opened[1])
+    assert len(opened[0] | opened[1]) == 6      # every shard assigned
+    # local_bs = 4; stripes split 21/19 rows -> min 19 // 4 = 4 batches,
+    # identical on every process (a ragged epoch would deadlock a
+    # multihost collective)
+    assert batch_counts[0] == batch_counts[1] == 4
+    assert not set(seen_rows[0]) & set(seen_rows[1])
+    # balance: greedy longest-first splits the 40 rows 21/19
+    totals = [sum(fs.shard_rows[s] for s in fs.shard_assignment[p])
+              for p in range(2)]
+    assert abs(totals[0] - totals[1]) <= 2
+    # row mode stays the default and bit-compatible
+    fs_row = DiskFeatureSet(cache, orca_context.mesh, batch_size=8,
+                            _pid=0, _nproc=2)
+    assert fs_row.shard_assignment is None
+    # more processes than shard files: the error names the real problem
+    # (stripe granularity), not the batch size
+    with pytest.raises(ValueError, match="smaller shard_size"):
+        DiskFeatureSet(cache, orca_context.mesh, batch_size=8,
+                       stripe="shard", _pid=0, _nproc=7)
