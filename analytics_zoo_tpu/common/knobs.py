@@ -118,6 +118,20 @@ _KNOBS = [
        "Redis broker reconnect attempts before giving up."),
     _k("ZOO_BROKER_RECONNECT_BACKOFF_S", "float", 0.2, "serving",
        "Base backoff between broker reconnect attempts."),
+    # --- serving scheduler --------------------------------------------------
+    _k("ZOO_SERVING_BATCH_SIZE", "int", 32, "serving",
+       "Max records per dispatched batch (the shape-bucket cap the "
+       "continuous former fills toward; the fixed policy's claim size)."),
+    _k("ZOO_SERVING_BATCH_TIMEOUT_MS", "float", 5.0, "serving",
+       "Broker idle-claim poll (and the legacy fixed policy's batch "
+       "formation stall). The continuous former never stalls on it."),
+    _k("ZOO_SERVING_MAX_INFLIGHT", "int", 256, "serving",
+       "Bound on admitted (decoded, queued or dispatching) requests across "
+       "all models; the claim pump stops claiming at the bound so memory "
+       "stays bounded ahead of the deadline shedder."),
+    _k("ZOO_SERVING_SLACK_MS", "float", 5.0, "serving",
+       "Dispatch-now threshold: a formed batch is dispatched immediately "
+       "once its head request's deadline slack drops to this."),
     # --- multihost ----------------------------------------------------------
     _k("ZOO_COORDINATOR", "str", None, "multihost",
        "host:port of the jax.distributed coordinator for multi-process "

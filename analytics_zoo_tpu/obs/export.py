@@ -256,9 +256,11 @@ def main(argv=None) -> int:
     sp = sub.add_parser("snapshot",
                         help="print one plane's tier-1 snapshot line "
                              "(the run_tier1.sh codepath)")
-    sp.add_argument("plane",
-                    choices=("transfer", "ckpt", "comms", "resilience",
-                             "analysis", "obs"))
+    # choices come from the snapshot registry itself (snapshots.py is a
+    # light import) so a new plane can't ship reachable from run_tier1.sh
+    # but rejected by the CLI
+    from .snapshots import PLANES
+    sp.add_argument("plane", choices=tuple(PLANES))
     args = ap.parse_args(argv)
 
     if args.cmd == "dump":

@@ -258,6 +258,66 @@ def snapshot_resilience() -> int:
         "breaker_state": res["breaker"]["state"]})
 
 
+def snapshot_serving() -> int:
+    """Two models multiplexed through the continuous deadline-aware batch
+    former (no JAX needed — host-side toy models keep this leg at
+    milliseconds): records served per model, expired-request sheds, and
+    the ``zoo_serving_*`` metric families the engine registers so
+    ``zoo-metrics`` lists them."""
+    import time
+
+    import numpy as np
+
+    from ..serving import ClusterServing, InMemoryBroker, ModelMultiplexer
+    from ..serving.codecs import encode_payload
+    from .registry import REGISTRY
+
+    class _Scale:
+        def __init__(self, k):
+            self.k = k
+
+        def predict(self, x):
+            return np.asarray(x) * self.k
+
+    mux = (ModelMultiplexer()
+           .add_model("double", _Scale(2.0))
+           .add_model("half", _Scale(0.5)))
+    broker = InMemoryBroker()
+    cs = ClusterServing(mux, queue=broker, batch_size=8, slack_ms=10.0,
+                        max_inflight=64)
+    n_live, n_expired = 24, 4
+    for i in range(n_expired):
+        broker.enqueue(f"x{i}", encode_payload(
+            np.ones(4, np.float32), meta={"deadline": time.time() - 1}))
+    for i in range(n_live):
+        broker.enqueue(f"l{i}", encode_payload(
+            np.ones(4, np.float32),
+            meta={"model": ("double", "half")[i % 2],
+                  "deadline": time.time() + 30}))
+    cs.start()
+    ok = 0
+    for i in range(n_live):
+        raw = broker.get_result(f"l{i}", 10.0)
+        ok += raw is not None
+    for i in range(n_expired):
+        broker.get_result(f"x{i}", 10.0)
+    m = cs.metrics()
+    cs.drain(timeout_s=10.0)
+    serving_families = sorted(
+        f.name for f in REGISTRY.families()
+        if f.name.startswith("zoo_serving_"))
+    sched = m["scheduler"]
+    return _emit("SERVING_PLANE", {
+        "policy": sched["policy"],
+        "models": sched["models"],
+        "records_out": m["records_out"],
+        "per_model_records": {k: v["records_out"]
+                              for k, v in sched["per_model"].items()},
+        "shed_expired": m["resilience"]["shed_expired"],
+        "results_ok": ok,
+        "metric_families": serving_families})
+
+
 def snapshot_analysis() -> int:
     """Repo lint findings, golden program-contract drift, and the HLO
     linter's hook report from a bucketed comms fit on the simulated
@@ -342,7 +402,8 @@ def snapshot_obs() -> int:
 
 PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,
           "comms": snapshot_comms, "resilience": snapshot_resilience,
-          "analysis": snapshot_analysis, "obs": snapshot_obs}
+          "serving": snapshot_serving, "analysis": snapshot_analysis,
+          "obs": snapshot_obs}
 
 
 def run(plane: str) -> int:

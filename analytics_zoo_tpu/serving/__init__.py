@@ -3,7 +3,9 @@ from .codecs import SparseTensor
 from .engine import ClusterServing, Timer
 from .queue_api import FileBroker, InMemoryBroker, RedisBroker, make_broker
 from .redis_protocol import MiniRedisServer, RedisClient
+from .scheduler import ContinuousScheduler, ModelMultiplexer
 
 __all__ = ["InputQueue", "OutputQueue", "ClusterServing", "Timer",
            "InMemoryBroker", "FileBroker", "RedisBroker", "MiniRedisServer",
-           "RedisClient", "make_broker", "SparseTensor"]
+           "RedisClient", "make_broker", "SparseTensor",
+           "ContinuousScheduler", "ModelMultiplexer"]

@@ -47,9 +47,13 @@ class InputQueue(API):
         self._last_pending = 0
         self._sent_since = 0
 
-    def enqueue(self, uri: str, **data) -> str:
+    def enqueue(self, uri: str, model_name: Optional[str] = None,
+                deadline: Optional[float] = None, **data) -> str:
         """enqueue(uri, t=ndarray) or multiple named tensors
-        (reference: client.py:144-233)."""
+        (reference: client.py:144-233). ``model_name`` routes to one of a
+        multiplexed engine's co-served models (default: the engine's
+        default model); ``deadline`` is an absolute epoch-seconds stamp the
+        engine sheds against."""
         if not data:
             raise ValueError("provide at least one named tensor, e.g. "
                              "input_api.enqueue('my-id', t=arr)")
@@ -66,20 +70,29 @@ class InputQueue(API):
         def norm(v):
             return v if isinstance(v, SparseTensor) else np.asarray(v)
 
+        meta: Dict[str, Any] = {"uri": uri}
+        if model_name is not None:
+            meta["model"] = model_name
+        if deadline is not None:
+            meta["deadline"] = float(deadline)
         if len(data) == 1:
             payload = encode_payload(norm(next(iter(data.values()))),
-                                     meta={"uri": uri})
+                                     meta=meta)
         else:
             payload = encode_payload({k: norm(v) for k, v in data.items()},
-                                     meta={"uri": uri})
+                                     meta=meta)
         self.broker.enqueue(uri, payload)
         return uri
 
-    def predict(self, request_data, timeout_s: float = 30.0):
+    def predict(self, request_data, timeout_s: float = 30.0,
+                model_name: Optional[str] = None):
         """Synchronous single prediction (reference: client.py:105-143)."""
         uri = uuid.uuid4().hex
+        meta: Dict[str, Any] = {"uri": uri}
+        if model_name is not None:
+            meta["model"] = model_name
         self.broker.enqueue(uri, encode_payload(np.asarray(request_data),
-                                                meta={"uri": uri}))
+                                                meta=meta))
         raw = self.broker.get_result(uri, timeout_s)
         if raw is None:
             raise TimeoutError(f"no result for {uri} within {timeout_s}s")

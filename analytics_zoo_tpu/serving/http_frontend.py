@@ -175,6 +175,19 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         if not isinstance(instances, list):
             return web.json_response({"error": "missing 'instances' list"},
                                      status=400)
+        # multi-model multiplexing: a body-level "model" field (or the
+        # X-Model header) routes every instance to one of the engine's
+        # co-served models; unknown names 404 here, before anything is
+        # enqueued, when an embedded engine can tell us
+        model_name = body.get("model") or request.headers.get("X-Model")
+        if model_name is not None and not isinstance(model_name, str):
+            return web.json_response(
+                {"error": f"bad 'model': {model_name!r}"}, status=400)
+        if model_name and serving is not None and \
+                model_name not in serving.mux:
+            return web.json_response(
+                {"error": f"unknown model {model_name!r}",
+                 "models": sorted(serving.mux.names())}, status=404)
         loop = asyncio.get_running_loop()
         if max_pending is not None:
             # bounded admission: reject BEFORE enqueuing anything, so an
@@ -226,6 +239,8 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         for data in parsed:
             uri = uuid.uuid4().hex
             meta = {"uri": uri, "deadline": deadline}
+            if model_name:
+                meta["model"] = model_name
             if tok:
                 meta["trace"] = tok
             broker.enqueue(uri, encode_payload(data, meta=meta))
@@ -375,8 +390,24 @@ def main(argv=None):
     p.add_argument("--tf-outputs", default=None,
                    help="comma-separated output tensor names for a bare "
                         "frozen .pb")
-    p.add_argument("--batch-size", type=int, default=32)
-    p.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="max records per dispatched batch (default: the "
+                        "ZOO_SERVING_BATCH_SIZE knob)")
+    p.add_argument("--batch-timeout-ms", type=float, default=None,
+                   help="broker idle-claim poll / legacy fixed-policy "
+                        "stall (default: the ZOO_SERVING_BATCH_TIMEOUT_MS "
+                        "knob)")
+    p.add_argument("--policy", choices=("continuous", "fixed"),
+                   default="continuous",
+                   help="batch former: continuous deadline-aware EDF "
+                        "scheduler (default) or the legacy fixed "
+                        "claim-up-to-batch-size loop")
+    p.add_argument("--max-inflight", type=int, default=None,
+                   help="bound on admitted in-flight requests (default: "
+                        "the ZOO_SERVING_MAX_INFLIGHT knob)")
+    p.add_argument("--slack-ms", type=float, default=None,
+                   help="dispatch-now deadline-slack threshold (default: "
+                        "the ZOO_SERVING_SLACK_MS knob)")
     p.add_argument("--max-pending", type=int, default=None,
                    help="bounded admission: reject predicts with 429 + "
                         "Retry-After once the broker backlog would exceed "
@@ -419,7 +450,9 @@ def main(argv=None):
             model.load(path)
         serving = ClusterServing(
             model, queue=args.queue, batch_size=args.batch_size,
-            batch_timeout_ms=args.batch_timeout_ms).start()
+            batch_timeout_ms=args.batch_timeout_ms, policy=args.policy,
+            max_inflight=args.max_inflight,
+            slack_ms=args.slack_ms).start()
 
     # run_frontend owns graceful SIGTERM handling: stop accepting (readyz
     # flips 503, predict 503s), finish every admitted request, flush the

@@ -35,9 +35,17 @@ fi
 # here). One process per plane: the comms/analysis snapshots configure the
 # 8-device simulated mesh themselves, which must happen before the JAX
 # backend first initializes. Never affects the exit code.
-for plane in transfer ckpt comms resilience analysis obs; do
+for plane in transfer ckpt comms resilience serving analysis obs; do
     env JAX_PLATFORMS=cpu \
         python -m analytics_zoo_tpu.obs snapshot "$plane" \
         2>/dev/null | grep -aE '^[A-Z_]+=' || true
 done
+# serving-scale smoke (SERVING_SCALE= line): the continuous batch former +
+# multi-model multiplexer under an open-loop 1x/3x/10x Poisson load on the
+# CPU backend — seconds, not minutes; like the plane snapshots it never
+# affects the exit code (the BENCH_DETAIL_SMOKE.json entry keeps the full
+# per-leg detail).
+env JAX_PLATFORMS=cpu BENCH_SMOKE=1 BENCH_ONLY=serving_scale \
+    python bench.py 2>/dev/null | grep -a '^{' | tail -1 \
+    | sed 's/^/SERVING_SCALE=/' || true
 exit $rc

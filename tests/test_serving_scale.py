@@ -1,0 +1,499 @@
+"""Continuous batching + multi-model multiplexed serving (ROADMAP item 4).
+
+The batch former is rebuilt as a deadline-aware EDF scheduler
+(serving/scheduler.py): per-(model, signature) admission queues behind the
+broker, dispatch when the shape bucket fills or the head request's slack
+hits the dispatch-now threshold, N models multiplexed on one chip set with
+per-model circuit breakers and zero cross-model compile churn."""
+
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import knobs
+from analytics_zoo_tpu.serving import (ClusterServing, InMemoryBroker,
+                                       InputQueue, MiniRedisServer,
+                                       ModelMultiplexer, OutputQueue,
+                                       RedisBroker)
+from analytics_zoo_tpu.serving.codecs import decode_payload, encode_payload
+from analytics_zoo_tpu.serving.scheduler import (ContinuousScheduler,
+                                                 ServingRequest,
+                                                 request_signature)
+
+
+class _Scale:
+    """Host-side toy model: predict multiplies by k."""
+
+    def __init__(self, k, delay_s=0.0):
+        self.k = k
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def predict(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.asarray(x) * self.k
+
+
+def _simple_model(seed=0, n_out=3, dim=4):
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(n_out)(x)
+
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(seed),
+                            np.zeros((1, dim), np.float32))
+    return InferenceModel().load_jax(module, variables)
+
+
+# --- knob registry (satellite: no new bespoke knob dicts) --------------------
+
+def test_serving_knobs_registered():
+    for name in ("ZOO_SERVING_BATCH_SIZE", "ZOO_SERVING_BATCH_TIMEOUT_MS",
+                 "ZOO_SERVING_MAX_INFLIGHT", "ZOO_SERVING_SLACK_MS"):
+        assert knobs.is_registered(name), name
+        assert knobs.REGISTRY[name].plane == "serving"
+    # defaults flow into the engine when the constructor args are left None
+    cs = ClusterServing(_Scale(1.0), queue=InMemoryBroker())
+    assert cs.batch_size == knobs.get("ZOO_SERVING_BATCH_SIZE")
+    assert cs.max_inflight == knobs.get("ZOO_SERVING_MAX_INFLIGHT")
+    assert cs.slack_s == knobs.get("ZOO_SERVING_SLACK_MS") / 1e3
+    cs._close_series()
+
+
+# --- scheduler unit behavior -------------------------------------------------
+
+def _req(item_id, deadline=None, model="m", data=None):
+    meta = {"uri": item_id}
+    if deadline is not None:
+        meta["deadline"] = deadline
+    return ServingRequest(item_id, data if data is not None
+                          else np.zeros(3, np.float32), meta, model)
+
+
+def test_scheduler_edf_order_and_sig_grouping():
+    sched = ContinuousScheduler(max_inflight=64, slack_s=0.0, form_s=0.001)
+    now = time.time()
+    # out-of-order deadlines, one model, one signature
+    for i, dl in enumerate((now + 9, now + 3, now + 6)):
+        assert sched.offer(_req(f"a{i}", deadline=dl))
+    # different signature routes to its own queue (stacking stays valid)
+    assert sched.offer(_req("b0", deadline=now + 1,
+                            data=np.zeros((2, 2), np.float32)))
+    sched.finish_input()
+    model, reqs = sched.next_batch(lambda m: 8)
+    # EDF across queues: the (2,2)-shaped request has the earliest deadline
+    assert [r.item_id for r in reqs] == ["b0"]
+    model, reqs = sched.next_batch(lambda m: 8)
+    assert [r.item_id for r in reqs] == ["a1", "a2", "a0"]
+    sched.done(4)
+    assert sched.next_batch(lambda m: 8) is None    # drained dry
+
+
+def test_request_signature_shapes():
+    a = np.zeros((3,), np.float32)
+    b = np.zeros((3,), np.float64)
+    assert request_signature(a) != request_signature(b)
+    assert request_signature({"x": a, "y": a}) != \
+        request_signature({"y": a, "x": a})      # key ORDER is the contract
+    assert request_signature([a, a]) == request_signature([a, a])
+
+
+def test_scheduler_bounded_inflight_blocks_offer():
+    sched = ContinuousScheduler(max_inflight=2, slack_s=0.0, form_s=0.001)
+    assert sched.offer(_req("r0"))
+    assert sched.offer(_req("r1"))
+    import threading
+    admitted = []
+
+    def third():
+        admitted.append(sched.offer(_req("r2")))
+
+    t = threading.Thread(target=third, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not admitted            # blocked at the bound
+    _, reqs = sched.next_batch(lambda m: 8)
+    sched.done(len(reqs))          # capacity frees -> the offer completes
+    t.join(timeout=5)
+    assert admitted == [True]
+    sched.close()
+
+
+# --- engine: continuous former edge cases ------------------------------------
+
+def test_single_request_dispatches_when_slack_hits_zero():
+    """Satellite edge case: one request on an otherwise-empty queue, with
+    the forming quantum made absurdly large — only the slack gate can
+    fire, and it must, before the deadline."""
+    broker = InMemoryBroker()
+    serving = ClusterServing(_Scale(2.0), queue=broker, batch_size=8,
+                             slack_ms=200.0, form_ms=60_000.0)
+    serving.start()
+    try:
+        t0 = time.time()
+        deadline = t0 + 1.2
+        broker.enqueue("solo", encode_payload(
+            np.ones(3, np.float32), meta={"deadline": deadline}))
+        raw = broker.get_result("solo", timeout_s=10)
+        elapsed = time.time() - t0
+        assert raw is not None
+        data, meta = decode_payload(raw)
+        assert not meta.get("error"), meta
+        np.testing.assert_allclose(np.asarray(data), 2.0 * np.ones(3))
+        # dispatched by the slack gate: after forming began but before the
+        # deadline (the 60s quantum alone would have blown it)
+        assert elapsed < 1.2, elapsed
+        assert elapsed > 0.3, ("dispatched before the slack gate could "
+                               f"have fired ({elapsed:.3f}s)")
+    finally:
+        serving.stop()
+
+
+def test_fully_expired_claim_emits_batch_span():
+    """Satellite edge case: a claim where EVERY request is already past
+    its deadline must shed-all AND still record a serving.batch span —
+    the overload case the Perfetto timeline exists to explain."""
+    from analytics_zoo_tpu.obs import trace
+
+    broker = InMemoryBroker()
+    serving = ClusterServing(_Scale(1.0), queue=broker, batch_size=8)
+    with trace.tracing(capacity=256):
+        for i in range(3):
+            broker.enqueue(f"x{i}", encode_payload(
+                np.ones(2, np.float32),
+                meta={"deadline": time.time() - 1.0}))
+        serving.start()
+        try:
+            for i in range(3):
+                raw = broker.get_result(f"x{i}", timeout_s=10)
+                assert raw is not None
+                _, meta = decode_payload(raw)
+                assert meta.get("shed") == "expired"
+            batch_spans = [s for s in trace.spans()
+                           if s.name == "serving.batch"]
+            assert batch_spans, "shed-all claim recorded no batch span"
+            assert any(s.attrs.get("shed") and s.attrs.get("n") == 0
+                       for s in batch_spans)
+        finally:
+            serving.stop()
+    assert serving.metrics()["resilience"]["shed_expired"] == 3
+
+
+def test_cross_model_starvation_guard():
+    """Satellite edge case: a slow model's backlog must not starve a fast
+    model past its deadline — EDF across the per-model queues dispatches
+    the fast model's (earlier-deadline) requests between slow batches."""
+    slow = _Scale(1.0, delay_s=0.12)
+    fast = _Scale(3.0)
+    mux = ModelMultiplexer().add_model("slow", slow).add_model("fast", fast)
+    broker = InMemoryBroker()
+    serving = ClusterServing(mux, queue=broker, batch_size=2,
+                             slack_ms=10.0).start()
+    try:
+        iq = InputQueue(queue=broker)
+        now = time.time()
+        slow_uris = [iq.enqueue(f"s{i}", model_name="slow",
+                                deadline=now + 30.0,
+                                t=np.ones(2, np.float32))
+                     for i in range(8)]
+        # fast requests arrive behind a ~0.5s slow backlog but with much
+        # tighter deadlines
+        fast_dl = time.time() + 2.0
+        fast_uris = [iq.enqueue(f"f{i}", model_name="fast",
+                                deadline=fast_dl,
+                                t=np.ones(2, np.float32))
+                     for i in range(4)]
+        for u in fast_uris:
+            raw = broker.get_result(u, timeout_s=10)
+            assert raw is not None
+            data, meta = decode_payload(raw)
+            assert not meta.get("error"), \
+                f"fast request starved past its deadline: {meta}"
+            np.testing.assert_allclose(np.asarray(data), 3.0 * np.ones(2))
+        assert time.time() < fast_dl + 0.5
+        for u in slow_uris:    # the slow model still completes everything
+            raw = broker.get_result(u, timeout_s=30)
+            _, meta = decode_payload(raw)
+            assert not meta.get("error"), meta
+    finally:
+        serving.stop()
+
+
+def test_bounded_inflight_backpressures_claim_pump():
+    """ZOO_SERVING_MAX_INFLIGHT bounds admitted memory: the claim pump
+    stops claiming at the bound, leaving the backlog on the broker."""
+    broker = InMemoryBroker()
+    serving = ClusterServing(_Scale(1.0, delay_s=0.02), queue=broker,
+                             batch_size=2, max_inflight=4).start()
+    try:
+        iq = InputQueue(queue=broker)
+        uris = [iq.enqueue(f"r{i}", t=np.ones(2, np.float32))
+                for i in range(40)]
+        max_seen = 0
+        saw_broker_backlog = False
+        for _ in range(50):
+            max_seen = max(max_seen,
+                           serving.metrics()["scheduler"]["inflight"])
+            saw_broker_backlog |= broker.pending() > 0
+            time.sleep(0.01)
+        results = OutputQueue(queue=broker).dequeue(uris, timeout_s=30)
+        assert len(results) == 40
+        assert max_seen <= 4, max_seen
+        assert saw_broker_backlog
+    finally:
+        serving.stop()
+
+
+def test_unknown_model_gets_error_result():
+    broker = InMemoryBroker()
+    serving = ClusterServing(_Scale(1.0), queue=broker, batch_size=4).start()
+    try:
+        iq = InputQueue(queue=broker)
+        uri = iq.enqueue("u1", model_name="nope", t=np.ones(2, np.float32))
+        raw = broker.get_result(uri, timeout_s=10)
+        assert raw is not None
+        _, meta = decode_payload(raw)
+        assert "unknown model" in meta.get("error", "")
+        assert serving.metrics()["resilience"]["unknown_model"] == 1
+    finally:
+        serving.stop()
+
+
+def test_fixed_policy_roundtrip_and_ab_parity():
+    """The legacy fixed former stays available as the bench baseline and
+    still serves correctly (including multi-model claims)."""
+    mux = ModelMultiplexer().add_model("a", _Scale(2.0)) \
+                            .add_model("b", _Scale(5.0))
+    broker = InMemoryBroker()
+    serving = ClusterServing(mux, queue=broker, batch_size=4,
+                             batch_timeout_ms=5, policy="fixed").start()
+    try:
+        iq = InputQueue(queue=broker)
+        uris = [(iq.enqueue(f"p{i}", model_name=("a", "b")[i % 2],
+                            t=np.full(2, i, np.float32)), i)
+                for i in range(12)]
+        for uri, i in uris:
+            raw = broker.get_result(uri, timeout_s=10)
+            data, meta = decode_payload(raw)
+            assert not meta.get("error"), meta
+            k = 2.0 if i % 2 == 0 else 5.0
+            np.testing.assert_allclose(np.asarray(data),
+                                       np.full(2, i) * k)
+        assert serving.metrics()["scheduler"]["policy"] == "fixed"
+    finally:
+        serving.stop()
+
+
+def test_drain_completes_admitted_backlog():
+    broker = InMemoryBroker()
+    serving = ClusterServing(_Scale(1.0, delay_s=0.01), queue=broker,
+                             batch_size=4, max_inflight=8).start()
+    iq = InputQueue(queue=broker)
+    uris = [iq.enqueue(f"d{i}", t=np.ones(2, np.float32))
+            for i in range(24)]
+    snap = serving.drain(timeout_s=30)
+    assert snap["records_out"] == 24
+    for u in uris:
+        raw = broker.get_result(u, timeout_s=5)
+        assert raw is not None
+        _, meta = decode_payload(raw)
+        assert not meta.get("error"), meta
+    assert broker.pending() == 0
+
+
+# --- multi-model multiplexing on one chip set --------------------------------
+
+def test_multi_model_coserving_zero_compile_churn(orca_context):
+    """Acceptance gate: >=2 real models co-served on one chip set with
+    ZERO cross-model compile churn — after start() warms every (model,
+    bucket) executable, an interleaved multi-model stream must add no
+    compiles (compile-plane counters asserted)."""
+    from analytics_zoo_tpu.compile import compile_stats
+
+    m_a = _simple_model(seed=0, n_out=3, dim=4)
+    m_b = _simple_model(seed=1, n_out=2, dim=6)
+    mux = (ModelMultiplexer()
+           .add_model("a", m_a, example=np.zeros((1, 4), np.float32))
+           .add_model("b", m_b, example=np.zeros((1, 6), np.float32)))
+    broker = InMemoryBroker()
+    serving = ClusterServing(mux, queue=broker, batch_size=8,
+                             slack_ms=20.0).start()
+    try:
+        # both models share the one device mesh (the whole point)
+        assert m_a.mesh.devices.tolist() == m_b.mesh.devices.tolist()
+        before = compile_stats()
+        warmed_before = mux.compile_stats()
+        iq = InputQueue(queue=broker)
+        uris = []
+        for i in range(40):
+            name = ("a", "b")[i % 2]
+            dim = 4 if name == "a" else 6
+            uris.append((iq.enqueue(f"m{i}", model_name=name,
+                                    t=np.full(dim, 1.0, np.float32)),
+                         name))
+        for uri, name in uris:
+            raw = broker.get_result(uri, timeout_s=30)
+            assert raw is not None
+            data, meta = decode_payload(raw)
+            assert not meta.get("error"), meta
+            assert np.asarray(data).shape == ((3,) if name == "a" else (2,))
+        after = compile_stats()
+        assert after.get("compiles", 0) == before.get("compiles", 0), \
+            (before, after)
+        sched = serving.metrics()["scheduler"]
+        assert sched["per_model"]["a"]["records_out"] == 20
+        assert sched["per_model"]["b"]["records_out"] == 20
+        # per-model warmed-signature counts flat across the interleaved
+        # stream: neither model re-warmed anything mid-traffic
+        per_model_compile = mux.compile_stats()
+        assert set(per_model_compile) == {"a", "b"}
+        assert per_model_compile == warmed_before
+        assert all(v["warmed_signatures"] >= 1
+                   for v in per_model_compile.values())
+    finally:
+        serving.stop()
+
+
+def test_per_model_breaker_isolates_wedged_model():
+    """A model that fails every batch opens ITS breaker; the healthy
+    neighbour keeps serving with its circuit closed."""
+
+    class _Broken:
+        def predict(self, x):
+            raise RuntimeError("wedged")
+
+    mux = (ModelMultiplexer(breaker_threshold=2)
+           .add_model("good", _Scale(2.0))
+           .add_model("bad", _Broken()))
+    broker = InMemoryBroker()
+    serving = ClusterServing(mux, queue=broker, batch_size=2,
+                             slack_ms=5.0).start()
+    try:
+        iq = InputQueue(queue=broker)
+        bad_uris = [iq.enqueue(f"b{i}", model_name="bad",
+                               t=np.ones(2, np.float32)) for i in range(6)]
+        for u in bad_uris:
+            raw = broker.get_result(u, timeout_s=10)
+            _, meta = decode_payload(raw)
+            assert meta.get("error")
+        good_uri = iq.enqueue("g0", model_name="good",
+                              t=np.ones(2, np.float32))
+        raw = broker.get_result(good_uri, timeout_s=10)
+        data, meta = decode_payload(raw)
+        assert not meta.get("error"), meta
+        per_model = serving.metrics()["scheduler"]["per_model"]
+        assert per_model["bad"]["breaker"]["state"] == "open"
+        assert per_model["good"]["breaker"]["state"] == "closed"
+    finally:
+        serving.stop()
+
+
+def test_multi_model_over_redis_broker():
+    """Per-model admission queues behind the Redis-stream broker too: the
+    same multiplexed engine co-serves two models over the RESP transport
+    (at-least-once claims included)."""
+    srv = MiniRedisServer(port=0).start()
+    try:
+        rbroker = RedisBroker("127.0.0.1", srv.port, stream="mm")
+        mux = (ModelMultiplexer()
+               .add_model("double", _Scale(2.0))
+               .add_model("neg", _Scale(-1.0)))
+        serving = ClusterServing(mux, queue=rbroker, batch_size=4,
+                                 slack_ms=10.0).start()
+        try:
+            iq = InputQueue(queue=rbroker)
+            uris = [(iq.enqueue(f"r{i}",
+                                model_name=("double", "neg")[i % 2],
+                                t=np.full(3, i, np.float32)), i)
+                    for i in range(10)]
+            for uri, i in uris:
+                raw = rbroker.get_result(uri, timeout_s=15)
+                assert raw is not None
+                data, meta = decode_payload(raw)
+                assert not meta.get("error"), meta
+                k = 2.0 if i % 2 == 0 else -1.0
+                np.testing.assert_allclose(np.asarray(data),
+                                           np.full(3, i) * k)
+            assert rbroker.pending() == 0
+        finally:
+            serving.stop()
+            rbroker.close()
+    finally:
+        srv.stop()
+
+
+def test_http_frontend_model_routing(orca_context):
+    """Body-level "model" (or X-Model header) routes a predict to one of
+    the co-served models; unknown names 404 before anything enqueues."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from analytics_zoo_tpu.serving.http_frontend import create_app
+
+    mux = (ModelMultiplexer()
+           .add_model("double", _Scale(2.0))
+           .add_model("half", _Scale(0.5)))
+    broker = InMemoryBroker()
+    serving = ClusterServing(mux, queue=broker, batch_size=4,
+                             slack_ms=10.0).start()
+    try:
+        async def run():
+            app = create_app(queue=broker, serving=serving)
+            async with TestClient(TestServer(app)) as client:
+                r_def = await client.post(
+                    "/predict", json={"instances": [{"t": [1.0, 2.0]}]})
+                r_half = await client.post(
+                    "/predict", json={"model": "half",
+                                      "instances": [{"t": [1.0, 2.0]}]})
+                r_hdr = await client.post(
+                    "/predict", json={"instances": [{"t": [4.0]}]},
+                    headers={"X-Model": "half"})
+                r_404 = await client.post(
+                    "/predict", json={"model": "nope",
+                                      "instances": [{"t": [1.0]}]})
+                return ((await r_def.json())["predictions"],
+                        (await r_half.json())["predictions"],
+                        (await r_hdr.json())["predictions"],
+                        r_404.status, await r_404.json())
+
+        p_def, p_half, p_hdr, s404, body404 = \
+            asyncio.new_event_loop().run_until_complete(run())
+        np.testing.assert_allclose(p_def[0], [2.0, 4.0])    # default=double
+        np.testing.assert_allclose(p_half[0], [0.5, 1.0])
+        np.testing.assert_allclose(p_hdr[0], [2.0])
+        assert s404 == 404 and sorted(body404["models"]) == \
+            ["double", "half"]
+    finally:
+        serving.stop()
+
+
+def test_serving_plane_snapshot_line():
+    """The run_tier1.sh serving leg: snapshot runs in-process and reports
+    multiplexed records + the registered zoo_serving_* metric families."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from analytics_zoo_tpu.obs import snapshots
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = snapshots.run("serving")
+    assert rc == 0
+    line = [ln for ln in buf.getvalue().splitlines()
+            if ln.startswith("SERVING_PLANE=")][0]
+    payload = json.loads(line.split("=", 1)[1])
+    assert payload["policy"] == "continuous"
+    assert payload["records_out"] == 24 and payload["results_ok"] == 24
+    assert payload["shed_expired"] >= 4
+    assert "zoo_serving_sched_queue_depth" in payload["metric_families"]
