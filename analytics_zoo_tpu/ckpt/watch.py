@@ -40,6 +40,14 @@ class CheckpointWatcher:
         self.last_step = -1 if start_at is None else int(start_at)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # delivery lock: poll_now is documented for manual rollout checks
+        # while the poll thread runs, and streaming commit cadences make
+        # that overlap routine (a watcher usually polls FASTER than
+        # commits land). Without serialization two concurrent polls can
+        # both read last_step, both load the multi-second checkpoint,
+        # and both hand the SAME step to the consumer — the model must
+        # never re-adopt the step it already serves.
+        self._poll_lock = threading.Lock()
 
     # --- polling ------------------------------------------------------------
     def _latest_committed(self):
@@ -51,7 +59,13 @@ class CheckpointWatcher:
 
     def poll_now(self) -> bool:
         """One synchronous check (tests and manual rollouts call this
-        directly). Returns True when a new checkpoint was delivered."""
+        directly). Returns True when a new checkpoint was delivered.
+        Serialized against the poll thread: each committed step reaches
+        the consumer at most once, however many pollers race."""
+        with self._poll_lock:
+            return self._poll_once()
+
+    def _poll_once(self) -> bool:
         path, step = self._latest_committed()
         if path is None:
             return False

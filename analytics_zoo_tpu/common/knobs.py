@@ -132,6 +132,27 @@ _KNOBS = [
     _k("ZOO_SERVING_SLACK_MS", "float", 5.0, "serving",
        "Dispatch-now threshold: a formed batch is dispatched immediately "
        "once its head request's deadline slack drops to this."),
+    # --- streaming plane ----------------------------------------------------
+    _k("ZOO_STREAM_WINDOW_RECORDS", "int", 1024, "streaming",
+       "Records per training window (rounded up to a whole number of "
+       "batches so every window reuses one warm executable)."),
+    _k("ZOO_STREAM_WINDOW_AGE_S", "float", 2.0, "streaming",
+       "Close an under-filled window after this many seconds, training "
+       "the largest whole-batch prefix (the freshness bound under low "
+       "traffic)."),
+    _k("ZOO_STREAM_WATERMARK_S", "float", 30.0, "streaming",
+       "Allowed event-time lateness: the watermark trails the max event "
+       "time seen by this many seconds; older records are late."),
+    _k("ZOO_STREAM_LATE_POLICY", "str", "drop", "streaming",
+       "What to do with late records: drop (ack + count) | include "
+       "(train anyway)."),
+    _k("ZOO_STREAM_MAX_BACKLOG", "int", 100000, "streaming",
+       "Broker backlog bound: past it, claimed records are shed (acked "
+       "unseen) until the consumer catches up — freshness over "
+       "completeness; shedding breaks bit-exact replay."),
+    _k("ZOO_STREAM_POLL_TIMEOUT_S", "float", 0.2, "streaming",
+       "Blocking-claim timeout per broker poll while a window "
+       "accumulates."),
     # --- multihost ----------------------------------------------------------
     _k("ZOO_COORDINATOR", "str", None, "multihost",
        "host:port of the jax.distributed coordinator for multi-process "

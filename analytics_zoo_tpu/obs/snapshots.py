@@ -400,10 +400,95 @@ def snapshot_obs() -> int:
         "perfetto_ok": perfetto_ok})
 
 
+def snapshot_streaming() -> int:
+    """The online-learning loop end to end on the bundled MiniRedisServer:
+    producer XADD -> windowed ChunkedArray ingest -> incremental fit ->
+    ckpt commit (cursor + trace in the manifest) -> hot-reload into a live
+    InferenceModel — records/s, freshness lag, zero recompiles after the
+    warm window, and the one-trace-id chain across all four thread hops."""
+    import time
+
+    import flax.linen as nn
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..orca.learn.estimator import TPUEstimator
+    from ..pipeline.inference.inference_model import InferenceModel
+    from ..serving.queue_api import RedisBroker
+    from ..serving.redis_protocol import MiniRedisServer
+    from ..streaming import (StreamingReloader, StreamingTrainer,
+                             StreamingXShards, encode_record, seq_id)
+    from . import trace
+
+    init_orca_context("local")
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    w_true = np.arange(8).astype(np.float32) / 8.0
+    srv = MiniRedisServer().start()
+    prod = RedisBroker(srv.host, srv.port, stream="t", group="g")
+    for i in range(64):
+        x = rng.rand(8).astype(np.float32)
+        prod.enqueue(seq_id(i), encode_record(
+            x, np.float32(x @ w_true), event_time=time.time()))
+
+    est = None
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            est = TPUEstimator(M(), loss="mse", optimizer="adam", seed=0,
+                               model_dir=d)
+            src = StreamingXShards(
+                RedisBroker(srv.host, srv.port, stream="t", group="g"),
+                batch_size=16, window_records=32, poll_timeout_s=0.05)
+            tr = StreamingTrainer(est, src, d)
+            import jax
+            model = InferenceModel()
+            model.load_jax(M(), {"params": jax.device_get(M().init(
+                jax.random.PRNGKey(0),
+                np.zeros((1, 8), np.float32))["params"])})
+            rel = StreamingReloader(model, d, poll_s=60, start_at=-1,
+                                    stats=src.stats)
+            trace.clear()
+            trace.arm()
+            try:
+                tr.run(max_windows=2, idle_timeout_s=5.0)
+                rel.poll_now()
+            finally:
+                # stop the async ckpt writer BEFORE TemporaryDirectory
+                # cleanup even when the run raised — a live writer racing
+                # the rmtree buries the real error in checkpoint noise
+                est.shutdown()
+                est = None
+            by_name: Dict[str, set] = {}
+            for s in trace.spans():
+                by_name.setdefault(s.name, set()).add(s.trace_id)
+            need = ("stream.ingest", "stream.assemble", "engine.dispatch",
+                    "ckpt.write", "stream.reload")
+            chained = [t for t in by_name.get("stream.window", ())
+                       if all(t in by_name.get(n, ()) for n in need)]
+            snap = src.stats.snapshot()
+    finally:
+        if est is not None:
+            est.shutdown()
+        srv.stop()
+    return _emit("STREAMING", {
+        "windows": snap["windows"],
+        "records_trained": snap["records_trained"],
+        "records_per_s": snap.get("last_records_per_s"),
+        "freshness_lag_s": snap.get("last_freshness_lag_s"),
+        "reloads": snap["reloads"],
+        "recompiles_after_warm": snap["recompiles_after_warm"],
+        "trace_ok": len(chained) >= 1})
+
+
 PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,
           "comms": snapshot_comms, "resilience": snapshot_resilience,
-          "serving": snapshot_serving, "analysis": snapshot_analysis,
-          "obs": snapshot_obs}
+          "serving": snapshot_serving, "streaming": snapshot_streaming,
+          "analysis": snapshot_analysis, "obs": snapshot_obs}
 
 
 def run(plane: str) -> int:

@@ -364,6 +364,14 @@ class InferenceModel:
             w.stop()
             self._watcher = None
 
+    def apply_checkpoint(self, path: str, state, step: int):
+        """Adopt an already-loaded checkpoint state into the live model —
+        the public form of the hot-reload callback, for consumers that
+        run their own CheckpointWatcher (the streaming plane's
+        ``StreamingReloader`` wraps it with a trace span + freshness
+        accounting). Same-shape states swap with zero new compiles."""
+        return self._hot_swap(path, state, step)
+
     def _hot_swap(self, path: str, state, step: int):
         import jax
         variables, module = self._state_to_variables(state)

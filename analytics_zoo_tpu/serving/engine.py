@@ -24,7 +24,7 @@ import logging
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -269,28 +269,10 @@ class ClusterServing:
         a result — error payloads for shed/failed ones — so frontend fetches
         never wait out their full timeout on a request the engine already
         gave up on."""
-        t_dec = time.perf_counter()
-        try:
-            reqs, n_shed, batch_tok = self._decode_and_shed(batch)
-        except Exception as e:  # noqa: BLE001 — injected/decode-stage fault
-            self.mux.default.breaker.record_failure()
-            self._count("batch_failures")
-            logger.exception("serving decode stage failed: %s", e)
-            for item_id, _ in batch:
-                self.broker.put_result(item_id, encode_payload(
-                    np.zeros(0), meta={"error": str(e)}))
+        prologue = self._decode_prologue(batch)
+        if prologue is None:
             return
-        _trace.record_span("serving.decode", t_dec, time.perf_counter(),
-                           parent=batch_tok, n=len(batch))
-        if not reqs:
-            if n_shed:
-                # a fully-expired claim still emits a batch span — exactly
-                # the overload case the Perfetto timeline should explain —
-                # chained to the shedding request instead of vanishing
-                t1 = time.perf_counter()
-                _trace.record_span("serving.batch", t1, t1,
-                                   parent=batch_tok, n=0, shed=n_shed)
-            return
+        reqs, _batch_tok = prologue
         admitted = self.sched.offer_many(reqs)
         for req in reqs[admitted:]:
             # closed mid-offer (stop during shutdown): answer rather
@@ -305,10 +287,14 @@ class ClusterServing:
         (absolute epoch seconds, stamped at admission) has passed is
         answered with an error payload and NEVER reaches the device. Routes
         the rest by ``meta.model`` (default: the multiplexer's first model).
-        Returns ``(requests, n_shed, trace_token)`` — the token of the
-        first decoded item CARRYING one (shed included)."""
+        Returns ``(requests, shed_replies, trace_token)`` — shed replies
+        are (item_id, payload) pairs the CALLER publishes after recording
+        the decode/batch spans (publishing here would let a fast client
+        observe every result before the shed-all batch span exists — the
+        span-vs-result race the streaming-cadence tests caught); the token
+        is the first decoded item's (shed included)."""
         reqs: List[ServingRequest] = []
-        n_shed = 0
+        shed: List[Tuple[str, bytes]] = []
         batch_tok = None
         default_model = self.mux.default_name
         with self.timer.time("decode"):
@@ -331,13 +317,12 @@ class ClusterServing:
                         np.zeros(0), meta={"error": f"bad payload: {e}"}))
                     continue
                 if expired:
-                    n_shed += 1
                     self._count("shed_expired")
                     STATS.add("serving.shed_expired")
-                    self.broker.put_result(item_id, encode_payload(
+                    shed.append((item_id, encode_payload(
                         np.zeros(0),
                         meta={"error": "deadline exceeded",
-                              "shed": "expired"}))
+                              "shed": "expired"})))
                     continue
                 model = meta.get("model") or default_model
                 if model not in self.mux:
@@ -359,7 +344,44 @@ class ClusterServing:
                     self._count("decode_errors")
                     self.broker.put_result(item_id, encode_payload(
                         np.zeros(0), meta={"error": f"bad payload: {e}"}))
-        return reqs, n_shed, batch_tok
+        return reqs, shed, batch_tok
+
+    def _publish_shed(self, shed):
+        for item_id, payload in shed:
+            self.broker.put_result(item_id, payload)
+
+    def _decode_prologue(self, batch):
+        """The shared claim prologue for BOTH claim paths (continuous
+        ``_route_claim`` and legacy ``_handle_fixed``): decode + shed with
+        whole-stage fault answering, the ``serving.decode`` span, and —
+        for a fully-expired claim — a shed-all ``serving.batch`` span
+        recorded BEFORE the shed answers publish (a fast client that saw
+        every result can rely on the span existing — exactly the overload
+        case the Perfetto timeline should explain). Returns
+        ``(requests, batch_token)``, or None when the claim was fully
+        answered here."""
+        t_dec = time.perf_counter()
+        try:
+            reqs, shed, batch_tok = self._decode_and_shed(batch)
+        except Exception as e:  # noqa: BLE001 — injected/decode-stage fault
+            self.mux.default.breaker.record_failure()
+            self._count("batch_failures")
+            logger.exception("serving decode stage failed: %s", e)
+            for item_id, _ in batch:
+                self.broker.put_result(item_id, encode_payload(
+                    np.zeros(0), meta={"error": str(e)}))
+            return None
+        _trace.record_span("serving.decode", t_dec, time.perf_counter(),
+                           parent=batch_tok, n=len(batch))
+        if not reqs:
+            if shed:
+                t1 = time.perf_counter()
+                _trace.record_span("serving.batch", t1, t1,
+                                   parent=batch_tok, n=0, shed=len(shed))
+            self._publish_shed(shed)
+            return None
+        self._publish_shed(shed)
+        return reqs, batch_tok
 
     # --- dispatch workers ----------------------------------------------------
     def _cap_for(self, model: str) -> int:
@@ -503,25 +525,10 @@ class ClusterServing:
             self._handle_fixed(batch)
 
     def _handle_fixed(self, batch):
-        t_dec = time.perf_counter()
-        try:
-            reqs, n_shed, batch_tok = self._decode_and_shed(batch)
-        except Exception as e:  # noqa: BLE001 — injected/decode-stage fault
-            self.mux.default.breaker.record_failure()
-            self._count("batch_failures")
-            logger.exception("serving decode stage failed: %s", e)
-            for item_id, _ in batch:
-                self.broker.put_result(item_id, encode_payload(
-                    np.zeros(0), meta={"error": str(e)}))
+        prologue = self._decode_prologue(batch)
+        if prologue is None:
             return
-        _trace.record_span("serving.decode", t_dec, time.perf_counter(),
-                           parent=batch_tok, n=len(batch))
-        if not reqs:
-            if n_shed:
-                t1 = time.perf_counter()
-                _trace.record_span("serving.batch", t1, t1,
-                                   parent=batch_tok, n=0, shed=n_shed)
-            return
+        reqs, _batch_tok = prologue
         # group by (model, signature) — a mixed claim dispatches per group
         groups: Dict = {}
         for r in reqs:

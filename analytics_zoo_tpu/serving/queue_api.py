@@ -46,6 +46,23 @@ class Broker:
     def pending(self) -> int:
         raise NotImplementedError
 
+    def ack(self, item_id: str) -> None:
+        """Acknowledge a claimed entry WITHOUT publishing a result — the
+        training-stream consumption path (streaming plane): records are
+        acked only after the window that trained them is durably
+        committed. The in-memory/file brokers consume destructively at
+        claim time (at-most-once), so this is a no-op for them; the
+        Redis broker XACKs/XDELs the pending entry, completing the
+        at-least-once contract without a ``result:`` hash."""
+        return None
+
+    def ack_many(self, item_ids) -> None:
+        """Batch form of :meth:`ack` (a streaming window commit acks its
+        whole window at once; the Redis broker turns this into ONE
+        XACK + ONE XDEL instead of two round trips per record)."""
+        for item_id in item_ids:
+            self.ack(item_id)
+
 
 class InMemoryBroker(Broker):
     _instances: Dict[str, "InMemoryBroker"] = {}
@@ -223,9 +240,14 @@ class RedisBroker(Broker):
         self._pending_acks: Dict[str, List[bytes]] = {}
         self._pending_lock = threading.Lock()
         try:
+            # the connect itself must ride the retry policy too (not just
+            # the command): _conn() evaluated as an argument would put the
+            # first connection OUTSIDE the backoff loop, so a broker
+            # coming up just after a restart would fail construction
             self._retry.call(
-                self._conn().execute, "XGROUP", "CREATE", self.stream,
-                self.group, "0", "MKSTREAM")
+                lambda: self._conn().execute(
+                    "XGROUP", "CREATE", self.stream, self.group, "0",
+                    "MKSTREAM"))
         except RedisError as e:
             if "BUSYGROUP" not in str(e):
                 raise
@@ -269,20 +291,27 @@ class RedisBroker(Broker):
                     ids.append(eid)
             except self._RedisError:
                 pass  # pre-6.2 Redis has no XAUTOCLAIM; skip recovery
-        if not batch:
+        if len(batch) < max_items:
+            # read fresh entries even when XAUTOCLAIM returned some: a
+            # consumer configured with a small claim_idle_ms (streaming
+            # restart recovery) would otherwise re-steal the same pending
+            # entries every poll and STARVE the new-traffic read — stolen
+            # entries merge ahead of fresh ones (PEL order, then stream
+            # order), the order a replay reproduces
             reply = c.execute(
                 "XREADGROUP", "GROUP", self.group, self.consumer,
-                "COUNT", max_items, "BLOCK", block_ms,
+                "COUNT", max_items - len(batch),
+                "BLOCK", 1 if batch else block_ms,
                 "STREAMS", self.stream, ">",
                 timeout_s=timeout_s + 5.0)
-            if not reply:
-                return []
-            for _key, entries in reply:
+            for _key, entries in (reply or []):
                 for eid, fields in entries:
                     kv = {fields[i]: fields[i + 1]
                           for i in range(0, len(fields), 2)}
                     batch.append((kv[b"uri"].decode(), kv[b"data"]))
                     ids.append(eid)
+        if not batch:
+            return []
         if ids:
             with self._pending_lock:
                 for (item_id, _), eid in zip(batch, ids):
@@ -307,6 +336,45 @@ class RedisBroker(Broker):
         if eid is not None:
             c.execute("XACK", self.stream, self.group, eid)
             c.execute("XDEL", self.stream, eid)
+
+    def ack(self, item_id):
+        """Resultless acknowledgement (streaming consumption): XACK + XDEL
+        every pending entry claimed under ``item_id``. All entries, not
+        one — a replayed/XAUTOCLAIM-stolen duplicate of the same record
+        must not leave a phantom forever-pending entry behind."""
+        self.ack_many([item_id])
+
+    def ack_many(self, item_ids):
+        self._retry.call(self._ack_all, list(item_ids))
+
+    def _ack_all(self, item_ids):
+        # eids leave _pending_acks only AFTER the server acknowledged
+        # them: popping first would make a transient-failure retry find
+        # nothing to ack and "succeed", leaving the entries pending in
+        # the PEL forever (the same argument-evaluation trap the
+        # constructor's retry fixes). XACK/XDEL are idempotent, so a
+        # retry that re-sends already-acked ids is harmless.
+        with self._pending_lock:
+            eids = [e for i in item_ids
+                    for e in self._pending_acks.get(i, ())]
+        if not eids:
+            return
+        c = self._conn()
+        # one XACK + one XDEL for the whole batch (a 1024-record window
+        # commit is 2 round trips, not 2048)
+        c.execute("XACK", self.stream, self.group, *eids)
+        c.execute("XDEL", self.stream, *eids)
+        done = set(eids)
+        with self._pending_lock:
+            for i in item_ids:
+                cur = self._pending_acks.get(i)
+                if not cur:
+                    continue
+                left = [e for e in cur if e not in done]
+                if left:
+                    self._pending_acks[i] = left
+                else:
+                    del self._pending_acks[i]
 
     def get_result(self, item_id, timeout_s=10.0):
         key = b"result:" + item_id.encode()

@@ -1,0 +1,30 @@
+"""Streaming plane — online learning on the request stream, hot-reloaded
+into serving.
+
+Closes the reference platform's headline loop (PAPER.md L2 data plane;
+Cluster Serving streaming) end to end:
+
+    producer XADD -> StreamingXShards (windowed ChunkedArray
+    micro-batches over the Redis/RESP2 transport) -> StreamingTrainer
+    (incremental fit on the scan-fused engine, one warm executable) ->
+    CheckpointPlane commit (stream cursor + trace token in the manifest)
+    -> StreamingReloader (CheckpointWatcher hot-swap into a live
+    InferenceModel, zero new compiles) -> fresher predictions, in
+    seconds.
+
+See ``docs/guides/streaming.md`` for window/watermark semantics, the
+cursor contract, and the freshness SLO; ``examples/streaming/
+online_ncf.py`` runs the whole tree in one process against the bundled
+MiniRedisServer.
+"""
+
+from .records import decode_record, encode_record, seq_id  # noqa: F401
+from .serve import StreamingReloader                       # noqa: F401
+from .source import (StreamCursor, StreamingXShards,       # noqa: F401
+                     Window)
+from .stats import StreamingStats                          # noqa: F401
+from .trainer import StreamingTrainer                      # noqa: F401
+
+__all__ = ["encode_record", "decode_record", "seq_id", "StreamCursor",
+           "Window", "StreamingXShards", "StreamingTrainer",
+           "StreamingReloader", "StreamingStats"]

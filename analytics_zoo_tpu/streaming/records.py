@@ -1,0 +1,98 @@
+"""Training-record wire format for the streaming plane.
+
+One stream entry = one training example: a tuple of feature arrays, an
+optional tuple of label arrays, and an **event time** (seconds since the
+epoch, stamped by the producer). The encoding is a small JSON header plus
+the raw C-contiguous array bytes — no pyarrow/pickle on the hot ingest
+path, and decode never copies (each leaf is a frombuffer view reshaped).
+
+Record **ids** are the streaming cursor's unit of progress: the cursor
+stores the id of the last *trained* record, and replayed entries with an
+id at or below it are deduplicated (see ``source.py``). That only works
+if ids are lexicographically monotonic in stream order — :func:`seq_id`
+renders a producer sequence number into such an id; producers with their
+own id scheme must preserve the same property (documented in
+``docs/guides/streaming.md``, "cursor contract").
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["encode_record", "decode_record", "seq_id"]
+
+_MAGIC = b"ZSR1"
+
+
+def seq_id(seq: int) -> str:
+    """A record id for producer sequence number ``seq`` that sorts
+    lexicographically in numeric order (20 digits covers int64)."""
+    if seq < 0:
+        raise ValueError(f"record sequence must be >= 0, got {seq}")
+    return f"{int(seq):020d}"
+
+
+def _contig(a) -> np.ndarray:
+    # NOT ascontiguousarray: that promotes 0-d scalars to 1-d, and a
+    # scalar label must round-trip as a scalar (stacked batches rely on
+    # per-record shapes being exact)
+    a = np.asarray(a)
+    return a if a.flags.c_contiguous else np.ascontiguousarray(a)
+
+
+def _as_tuple(v) -> Tuple[np.ndarray, ...]:
+    if v is None:
+        return ()
+    if isinstance(v, (list, tuple)):
+        return tuple(_contig(a) for a in v)
+    return (_contig(v),)
+
+
+def encode_record(x, y=None, event_time: Optional[float] = None) -> bytes:
+    """Encode one training example. ``x``/``y`` are arrays or tuples of
+    arrays (per-example shape, no batch dim); ``event_time`` defaults to
+    0.0 — producers should stamp their own clock so freshness lag is
+    measured from the event, not from ingestion."""
+    xs, ys = _as_tuple(x), _as_tuple(y)
+    header = {
+        "t": float(event_time) if event_time is not None else 0.0,
+        "x": [{"shape": list(a.shape), "dtype": a.dtype.str} for a in xs],
+        "y": ([{"shape": list(a.shape), "dtype": a.dtype.str} for a in ys]
+              if y is not None else None),
+    }
+    head = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    parts = [_MAGIC, len(head).to_bytes(4, "big"), head]
+    for a in xs + ys:
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_record(raw: bytes
+                  ) -> Tuple[Tuple[np.ndarray, ...],
+                             Optional[Tuple[np.ndarray, ...]], float]:
+    """Decode :func:`encode_record` bytes -> (x_tuple, y_tuple|None,
+    event_time). Leaves are zero-copy views into ``raw``."""
+    if raw[:4] != _MAGIC:
+        raise ValueError("not a streaming record (bad magic)")
+    hlen = int.from_bytes(raw[4:8], "big")
+    header = json.loads(raw[8:8 + hlen].decode("utf-8"))
+    off = 8 + hlen
+
+    def take(specs: Sequence[dict]) -> Tuple[np.ndarray, ...]:
+        nonlocal off
+        out = []
+        for spec in specs:
+            dt = np.dtype(spec["dtype"])
+            shape = tuple(spec["shape"])
+            n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            out.append(np.frombuffer(raw, dt, count=max(
+                n // dt.itemsize, 0), offset=off).reshape(shape))
+            off += n
+        return tuple(out)
+
+    xs = take(header["x"])
+    ys = take(header["y"]) if header["y"] is not None else None
+    return xs, ys, float(header["t"])

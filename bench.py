@@ -206,6 +206,151 @@ def _compute_loop_scanned(engine, dev_batch, steps: int) -> float:
     return best
 
 
+def bench_streaming(smoke: bool) -> dict:
+    """Streaming-plane bench: the online-learning loop end to end on the
+    bundled MiniRedisServer — a producer thread XADDs NCF-style records
+    while the StreamingTrainer consumes count windows through incremental
+    fit and commits through the checkpoint plane, and a hot-reload
+    watcher swaps each commit into a live InferenceModel.
+
+    Reported: trained records/s (the headline ``value``), per-reload
+    freshness lag (event time of the newest trained record -> wall clock
+    at adoption) p50/p99, reload count, and the zero-recompile assertion
+    — after window 1's single compile, every later window and every
+    reload must reuse the warm executables (``recompiles_after_warm == 0``
+    and 0 serving compiles across reloads), compile_stats-asserted.
+    CPU-friendly; tier1.yml gates zero_recompile + reloads >= 1.
+    """
+    import tempfile
+    import threading
+
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        InferenceModel
+    from analytics_zoo_tpu.serving.queue_api import RedisBroker
+    from analytics_zoo_tpu.serving.redis_protocol import MiniRedisServer
+    from analytics_zoo_tpu.streaming import (StreamingReloader,
+                                             StreamingTrainer,
+                                             StreamingXShards,
+                                             encode_record, seq_id)
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+
+    n_users, n_items = (600, 370) if smoke else (6040, 3706)
+    embed = 8 if smoke else 32
+    batch = 64 if smoke else 256
+    window = batch * 2 if smoke else batch * 4
+    n_windows = 3 if smoke else 8
+    total = window * n_windows
+
+    class OnlineNCF(nn.Module):
+        """Two-tower dot-product NCF (the streaming guide's demo model)."""
+        @nn.compact
+        def __call__(self, pairs):
+            import jax.numpy as jnp
+            u = nn.Embed(n_users, embed)(pairs[:, 0])
+            v = nn.Embed(n_items, embed)(pairs[:, 1])
+            x = jnp.concatenate([u * v, u, v], axis=-1)
+            x = nn.relu(nn.Dense(embed)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    srv = MiniRedisServer().start()
+    prod = RedisBroker(srv.host, srv.port, stream="ncf", group="train")
+
+    stop_feed = threading.Event()
+
+    def feed():
+        for i in range(total):
+            if stop_feed.is_set():
+                return
+            pair = np.array([rng.randint(0, n_users),
+                             rng.randint(0, n_items)], np.int32)
+            rating = np.float32(rng.rand())
+            prod.enqueue(seq_id(i), encode_record(
+                pair, rating, event_time=time.time()))
+
+    feeder = threading.Thread(target=feed, name="stream-producer",
+                              daemon=True)
+
+    root = tempfile.mkdtemp(prefix="zoo-stream-bench-")
+    est = reloader = None
+    try:
+        module = OnlineNCF()
+        est = TPUEstimator(module, loss="mse", optimizer="adam", seed=0,
+                           model_dir=root)
+        src = StreamingXShards(
+            RedisBroker(srv.host, srv.port, stream="ncf", group="train"),
+            batch_size=batch, window_records=window, poll_timeout_s=0.05)
+        trainer = StreamingTrainer(est, src, root)
+
+        model = InferenceModel()
+        model.load_jax(module, {"params": jax.device_get(module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 2), np.int32))["params"])})
+        probe = np.stack([np.arange(8) % n_users,
+                          np.arange(8) % n_items], -1).astype(np.int32)
+        model.predict(probe)            # warm the serving bucket
+
+        def serving_compiles_now() -> int:
+            # the model compiles through the PROCESS-WIDE cache; count only
+            # its own "serving"-labelled programs, not the trainer's
+            if model._cc is None:
+                return 0
+            return int(model._cc.stats.counts("serving")["compiles"])
+
+        serving_compiles_before = serving_compiles_now()
+        reloader = StreamingReloader(model, root, poll_s=0.05,
+                                     start_at=-1, stats=src.stats).start()
+
+        feeder.start()
+        t0 = time.perf_counter()
+        trainer.run(max_windows=n_windows, idle_timeout_s=30.0)
+        wall = time.perf_counter() - t0
+        # let the watcher adopt the final commit before reading counters
+        deadline = time.time() + 5.0
+        while reloader.stats.snapshot().get("last_reload_step") != \
+                est.engine.step and time.time() < deadline:
+            time.sleep(0.05)
+        model.predict(probe)            # post-reload predict: warm path
+        serving_compiles = serving_compiles_now() - serving_compiles_before
+        snap = src.stats.snapshot()
+        p50, p99 = reloader.freshness_percentiles()
+        records_per_s = snap["records_trained"] / max(wall, 1e-9)
+        zero_recompile = (snap["recompiles_after_warm"] == 0
+                          and serving_compiles == 0)
+        return {
+            "metric": "streaming_records_per_sec",
+            "value": round(records_per_s, 1),
+            "unit": "records/s",
+            # freshness is the plane's SLO; a single-host CPU loop that
+            # keeps lag within one window of wall time is "at baseline"
+            "vs_baseline": (round(min(1.0, (wall / n_windows) / p99), 3)
+                            if p99 else None),
+            "windows": snap["windows"],
+            "records_trained": snap["records_trained"],
+            "freshness_p50_s": round(p50, 3) if p50 is not None else None,
+            "freshness_p99_s": round(p99, 3) if p99 is not None else None,
+            "reloads": snap["reloads"],
+            "recompiles_after_warm": snap["recompiles_after_warm"],
+            "serving_reload_compiles": serving_compiles,
+            "zero_recompile": bool(zero_recompile),
+            "backlog_final": snap.get("last_backlog"),
+        }
+    finally:
+        # stop the watcher + ckpt writer BEFORE deleting their root, on
+        # the failure path too — a live writer racing the rmtree buries
+        # the real error under unreadable-checkpoint noise
+        stop_feed.set()
+        if reloader is not None:
+            reloader.stop()
+        if est is not None:
+            est.shutdown()
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_resnet50(smoke: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -2193,7 +2338,7 @@ def main():
                "compile_plane": bench_compile_plane,
                "infeed": bench_infeed, "ckpt": bench_ckpt,
                "comms": bench_comms, "resilience": bench_resilience,
-               "obs": bench_obs}
+               "obs": bench_obs, "streaming": bench_streaming}
     # smoke runs must never clobber full-run artifacts (vs_baseline on a
     # reduced workload against a full-scale baseline is meaningless)
     detail_name = "BENCH_DETAIL_SMOKE.json" if smoke else "BENCH_DETAIL.json"
@@ -2239,7 +2384,8 @@ def main():
                       ("infeed", "infeed_wire_reduction"),
                       ("ckpt", "ckpt_async_hiding"),
                       ("comms", "comms_collective_reduction"),
-                      ("obs", "obs_disarmed_overhead")):
+                      ("obs", "obs_disarmed_overhead"),
+                      ("streaming", "streaming_records_per_s")):
         r = detail.get(name, {})
         if r and "error" not in r:
             out[f"{key}_value"] = r["value"]

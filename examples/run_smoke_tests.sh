@@ -23,6 +23,7 @@ for script in \
     examples/tfpark/bert_intent_classification.py \
     examples/serving/object_detection_serving.py \
     examples/streaming/streaming_object_detection.py \
+    examples/streaming/online_ncf.py \
     examples/textclassification/news_text_classification.py \
     examples/anomalydetection/anomaly_detection_time_series.py \
     examples/vision/image_augmentation.py \

@@ -437,3 +437,73 @@ def test_watcher_skips_step_its_consumer_rejects(tmp_path):
     assert calls == [1]                             # NOT re-delivered
     plane.save(_state(), 2)                         # a newer one still lands
     assert not w.poll_now() and calls == [1, 2]
+
+
+def test_watcher_concurrent_polls_deliver_each_step_once(tmp_path,
+                                                         monkeypatch):
+    """Streaming-cadence regression (ISSUE 15): with commits landing every
+    few seconds and a watcher polling FASTER than the commit cadence,
+    manual ``poll_now`` rollout checks routinely overlap the poll thread.
+    Overlapping polls must never hand the consumer a step it already
+    serves — delivery is serialized, so each committed step is adopted
+    exactly once even when the checkpoint load is slow."""
+    import threading
+    import time as _time
+
+    from analytics_zoo_tpu.ckpt import watch as watch_mod
+
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    real_load = ckpt_fmt.load_checkpoint_dir
+
+    def slow_load(path, passphrase=None):
+        _time.sleep(0.05)       # widen the read-then-deliver race window
+        return real_load(path, passphrase)
+
+    monkeypatch.setattr(watch_mod.fmt, "load_checkpoint_dir", slow_load)
+    delivered = []
+    lock = threading.Lock()
+
+    def adopt(path, state, step):
+        with lock:
+            delivered.append(step)
+
+    w = CheckpointWatcher(str(tmp_path), adopt, poll_s=60)
+    for step in (1, 2, 3):
+        plane.save(_state(), step)
+        threads = [threading.Thread(target=w.poll_now, daemon=True,
+                                    name=f"poll-{step}-{i}")
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    # each step delivered exactly once, in order — no re-adoption
+    assert delivered == [1, 2, 3]
+
+
+def test_watcher_rejected_step_read_once_across_fast_polls(tmp_path,
+                                                           monkeypatch):
+    """The PR-6 skip logic, restated for streaming cadence: a consumer-
+    rejected step must not be RE-READ on every poll — a fast watcher
+    would otherwise re-load a multi-GB checkpoint it can never swap,
+    every poll_s, forever."""
+    from analytics_zoo_tpu.ckpt import watch as watch_mod
+
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    plane.save(_state(), 1)
+    reads = []
+    real_load = ckpt_fmt.load_checkpoint_dir
+
+    def counting_load(path, passphrase=None):
+        reads.append(path)
+        return real_load(path, passphrase)
+
+    monkeypatch.setattr(watch_mod.fmt, "load_checkpoint_dir", counting_load)
+
+    def reject(path, state, step):
+        raise RuntimeError("incompatible module")
+
+    w = CheckpointWatcher(str(tmp_path), reject, poll_s=60)
+    for _ in range(5):                  # a fast poll loop
+        assert not w.poll_now()
+    assert len(reads) == 1              # read once, skipped thereafter

@@ -1,0 +1,398 @@
+"""Streaming plane (analytics_zoo_tpu.streaming): windowed ChunkedArray
+ingest off the Redis transport, incremental fit with zero recompiles
+after the warm window, cursor-carrying commits with bit-exact SIGTERM
+resume, PEL/XAUTOCLAIM replay dedup under an injected broker fault, and
+the one-trace-id ingest -> train -> commit -> hot-reload chain.
+"""
+
+import os
+import signal
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.orca.data.chunked import ChunkedArray
+from analytics_zoo_tpu.serving.queue_api import InMemoryBroker, RedisBroker
+from analytics_zoo_tpu.serving.redis_protocol import MiniRedisServer
+from analytics_zoo_tpu.streaming import (StreamCursor, StreamingReloader,
+                                         StreamingTrainer, StreamingXShards,
+                                         decode_record, encode_record,
+                                         seq_id)
+
+BS = 16
+DIM = 8
+W_TRUE = (np.arange(DIM).astype(np.float32) / DIM)
+
+
+def _record(rng, i, event_time=None, x=None):
+    x = rng.rand(DIM).astype(np.float32) if x is None else x
+    return seq_id(i), encode_record(
+        x, np.float32(x @ W_TRUE),
+        event_time=event_time if event_time is not None else 1e9 + i)
+
+
+def _fill(broker, rng, lo, hi, **kw):
+    for i in range(lo, hi):
+        rid, payload = _record(rng, i, **kw)
+        broker.enqueue(rid, payload)
+
+
+def _model():
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)[:, 0]
+
+    return M()
+
+
+def _estimator(model_dir, module=None, seed=0):
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+    return TPUEstimator(module if module is not None else _model(),
+                        loss="mse", optimizer="adam", seed=seed,
+                        model_dir=model_dir)
+
+
+def _params(est):
+    import jax
+    return jax.device_get(est.engine.get_state()["params"])
+
+
+def _tree_equal(a, b):
+    import jax
+    la, sa = jax.tree_util.tree_flatten(a)
+    lb, sb = jax.tree_util.tree_flatten(b)
+    return sa == sb and all(np.array_equal(np.asarray(x), np.asarray(y))
+                            for x, y in zip(la, lb))
+
+
+# --- records -----------------------------------------------------------------
+
+def test_record_codec_roundtrip():
+    x = (np.arange(6, dtype=np.float32).reshape(2, 3),
+         np.array([1, 2], np.int32))
+    y = (np.float32(0.25),)
+    raw = encode_record(x, y, event_time=123.5)
+    dx, dy, et = decode_record(raw)
+    assert et == 123.5
+    assert all(np.array_equal(a, b) for a, b in zip(x, dx))
+    assert np.array_equal(np.asarray(y[0]), dy[0])
+    # labelless records decode to y=None (pure-unsupervised streams)
+    dx2, dy2, _ = decode_record(encode_record(np.ones(3, np.uint8)))
+    assert dy2 is None and dx2[0].dtype == np.uint8
+    # ids sort numerically under lexicographic order — the cursor contract
+    assert seq_id(2) < seq_id(10) < seq_id(123456789)
+
+
+# --- window semantics --------------------------------------------------------
+
+def test_count_window_closes_and_chunks_per_batch():
+    rng = np.random.RandomState(0)
+    broker = InMemoryBroker()
+    _fill(broker, rng, 0, 3 * BS)
+    src = StreamingXShards(broker, batch_size=BS, window_records=2 * BS,
+                           poll_timeout_s=0.01)
+    w = src.next_window(StreamCursor())
+    assert w.n == 2 * BS and w.ids[0] == seq_id(0)
+    assert isinstance(w.x[0], ChunkedArray)
+    # one chunk per training batch: deterministic boundaries, zero-copy
+    assert w.x[0].num_chunks == 2 and w.x[0].shape == (2 * BS, DIM)
+    shards = w.to_xshards()
+    assert shards.num_partitions() == 2
+    # assembled columns are bit-identical to the record stream (same rng
+    # stream _fill consumed)
+    rng2 = np.random.RandomState(0)
+    flat = np.stack([rng2.rand(DIM).astype(np.float32)
+                     for _ in range(2 * BS)])
+    assert np.array_equal(w.x[0].slice(0, w.n), flat)
+
+
+def test_window_records_rounded_to_whole_batches():
+    src = StreamingXShards(InMemoryBroker(), batch_size=BS,
+                           window_records=BS + 3, poll_timeout_s=0.01)
+    assert src.window_records == 2 * BS
+
+
+def test_age_close_trains_whole_batch_prefix_and_carries_tail():
+    rng = np.random.RandomState(1)
+    broker = InMemoryBroker()
+    _fill(broker, rng, 0, BS + 5)
+    src = StreamingXShards(broker, batch_size=BS, window_records=4 * BS,
+                           window_age_s=0.05, poll_timeout_s=0.01)
+    w = src.next_window(StreamCursor())
+    assert w.n == BS                      # whole-batch prefix only
+    # the 5-record tail leads the NEXT window, in order
+    _fill(broker, rng, BS + 5, 2 * BS + 5)
+    cur = StreamCursor(last_id=w.last_id, window=1)
+    w2 = src.next_window(cur)
+    assert w2.ids[0] == seq_id(BS) and w2.n == BS
+    # a buffer smaller than one batch never closes (no partial-batch
+    # executable): with 3 records the deadline path returns None
+    _fill(broker, rng, 2 * BS + 5, 2 * BS + 8)
+    assert src.next_window(
+        StreamCursor(last_id=w2.last_id, window=2),
+        idle_s=0.15) is None
+
+
+def test_watermark_late_records_drop_and_include():
+    rng = np.random.RandomState(2)
+    for policy, dropped, included in (("drop", 1, 0), ("include", 0, 1)):
+        broker = InMemoryBroker()
+        # 16 fresh records at t=1e9+100, then one 200s-late straggler
+        for i in range(BS):
+            rid, payload = _record(rng, i, event_time=1e9 + 100)
+            broker.enqueue(rid, payload)
+        rid, payload = _record(rng, BS, event_time=1e9 - 100)
+        broker.enqueue(rid, payload)
+        _fill(broker, rng, BS + 1, 2 * BS + 1, event_time=1e9 + 101)
+        src = StreamingXShards(broker, batch_size=BS,
+                               window_records=2 * BS, watermark_s=10.0,
+                               late_policy=policy, poll_timeout_s=0.01)
+        w = src.next_window(StreamCursor(), idle_s=2.0)
+        snap = src.stats.snapshot()
+        assert snap["late_dropped"] == dropped
+        assert snap["late_included"] == included
+        if policy == "drop":
+            assert seq_id(BS) not in w.ids
+        else:
+            assert seq_id(BS) in w.ids
+
+
+def test_backlog_shed_acks_unseen():
+    rng = np.random.RandomState(3)
+    broker = InMemoryBroker()
+    _fill(broker, rng, 0, 4 * BS)
+    src = StreamingXShards(broker, batch_size=BS, window_records=BS,
+                           max_backlog=BS, claim_size=BS,
+                           poll_timeout_s=0.01)
+    w = src.next_window(StreamCursor())     # backlog 4*BS > BS: shed
+    snap = src.stats.snapshot()
+    assert snap["records_shed"] > 0
+    assert w.n == BS
+
+
+# --- cursor + resume ---------------------------------------------------------
+
+def test_cursor_rides_manifest_and_resume_restores_it(tmp_path):
+    rng = np.random.RandomState(4)
+    broker = InMemoryBroker()
+    _fill(broker, rng, 0, 2 * BS)
+    src = StreamingXShards(broker, batch_size=BS, window_records=2 * BS,
+                           poll_timeout_s=0.01)
+    est = _estimator(str(tmp_path))
+    tr = StreamingTrainer(est, src, str(tmp_path))
+    assert tr.resume() is False             # fresh dir: nothing to resume
+    tr.run(max_windows=1, idle_timeout_s=2.0)
+    assert tr.cursor.window == 1
+    assert tr.cursor.last_id == seq_id(2 * BS - 1)
+    est.shutdown()
+
+    est2 = _estimator(str(tmp_path))
+    tr2 = StreamingTrainer(
+        est2, StreamingXShards(broker, batch_size=BS,
+                               window_records=2 * BS, poll_timeout_s=0.01),
+        str(tmp_path))
+    assert tr2.resume() is True
+    assert tr2.cursor == tr.cursor
+    assert _tree_equal(_params(est2), _params(est))
+    est2.shutdown()
+
+
+def test_sigterm_mid_window_resumes_bit_exactly():
+    """Acceptance: a real SIGTERM mid-window, a restart, and byte-identical
+    final weights vs the fault-free run — replayed records ride the
+    PEL/XAUTOCLAIM path and dedup against the committed cursor."""
+    rng = np.random.RandomState(5)
+    recs = [_record(rng, i) for i in range(4 * BS)]
+
+    def run(fault: bool):
+        srv = MiniRedisServer().start()
+        prod = RedisBroker(srv.host, srv.port, stream="t", group="g")
+        d = tempfile.mkdtemp()
+        try:
+            if not fault:
+                for rid, p in recs:
+                    prod.enqueue(rid, p)
+                est = _estimator(d)
+                src = StreamingXShards(
+                    RedisBroker(srv.host, srv.port, stream="t", group="g"),
+                    batch_size=BS, window_records=2 * BS,
+                    poll_timeout_s=0.02)
+                StreamingTrainer(est, src, d).run(max_windows=2,
+                                                  idle_timeout_s=5.0)
+                out = _params(est)
+                est.shutdown()
+                return out
+            # window 1 complete + half of window 2, then SIGTERM while the
+            # under-filled window accumulates
+            for rid, p in recs[:3 * BS]:
+                prod.enqueue(rid, p)
+            est = _estimator(d)
+            src = StreamingXShards(
+                RedisBroker(srv.host, srv.port, stream="t", group="g"),
+                batch_size=BS, window_records=2 * BS, poll_timeout_s=0.02)
+            tr = StreamingTrainer(est, src, d)
+            killer = threading.Timer(
+                1.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+            killer.start()
+            tr.run(max_windows=2, idle_timeout_s=15.0)
+            killer.cancel()
+            assert tr.stats.snapshot()["windows"] == 1   # died mid-window 2
+            est.shutdown()
+            # restart: fresh consumer steals the claimed-unacked records
+            for rid, p in recs[3 * BS:]:
+                prod.enqueue(rid, p)
+            est2 = _estimator(d)
+            src2 = StreamingXShards(
+                RedisBroker(srv.host, srv.port, stream="t", group="g",
+                            claim_idle_ms=0),
+                batch_size=BS, window_records=2 * BS, poll_timeout_s=0.02)
+            tr2 = StreamingTrainer(est2, src2, d)
+            assert tr2.resume()
+            assert tr2.cursor.window == 1
+            tr2.run(max_windows=1, idle_timeout_s=5.0)
+            out = _params(est2)
+            est2.shutdown()
+            return out
+        finally:
+            srv.stop()
+
+    assert _tree_equal(run(fault=False), run(fault=True))
+
+
+def test_replay_dedup_via_pel_under_injected_broker_fault():
+    """Crash between commit and ack: the replayed entries must dedup
+    against the cursor (exactly-once application) — with the replacement
+    consumer's first connect hit by an injected ``broker.connect`` fault,
+    so the XAUTOCLAIM recovery path also exercises reconnect-with-backoff.
+    """
+    from analytics_zoo_tpu.resilience import faults
+
+    rng = np.random.RandomState(6)
+    srv = MiniRedisServer().start()
+    try:
+        prod = RedisBroker(srv.host, srv.port, stream="t", group="g")
+        _fill(prod, rng, 0, 2 * BS)
+        d = tempfile.mkdtemp()
+        est = _estimator(d)
+        src = StreamingXShards(
+            RedisBroker(srv.host, srv.port, stream="t", group="g"),
+            batch_size=BS, window_records=2 * BS, poll_timeout_s=0.02)
+        tr = StreamingTrainer(est, src, d)
+        w = src.next_window(tr.cursor)
+        tr._train_window(w)
+        tr._commit(w)
+        # "crash" here: no ack — all 2*BS entries stay in the group PEL
+        est.shutdown()
+
+        _fill(prod, rng, 2 * BS, 3 * BS)    # fresh traffic after restart
+        with faults.inject("broker.connect", count=1, kind="connection"):
+            est2 = _estimator(d)
+            src2 = StreamingXShards(
+                RedisBroker(srv.host, srv.port, stream="t", group="g",
+                            claim_idle_ms=0),
+                batch_size=BS, window_records=BS, poll_timeout_s=0.02)
+            tr2 = StreamingTrainer(est2, src2, d)
+            assert tr2.resume()
+            tr2.run(max_windows=1, idle_timeout_s=5.0)
+        snap = src2.stats.snapshot()
+        assert snap["records_deduped"] >= 2 * BS    # full replay deduped
+        assert snap["records_trained"] == BS        # only the fresh window
+        assert tr2.cursor.last_id == seq_id(3 * BS - 1)
+        # deduped entries were acked + XDELed: the stream fully compacts
+        c = prod._conn()
+        assert int(c.execute("XLEN", b"t")) == 0
+        est2.shutdown()
+    finally:
+        srv.stop()
+
+
+# --- end-to-end: freshness, trace, zero recompiles ---------------------------
+
+def test_e2e_freshness_trace_and_zero_recompiles(tmp_path):
+    """Acceptance: an XADD'd record changes the served prediction within a
+    bounded number of windows; ONE trace id spans ingest -> assemble ->
+    train dispatch -> ckpt commit -> serving reload; zero new compiles
+    after the first window on both the train and serving side."""
+    import jax
+
+    from analytics_zoo_tpu.obs import trace
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        InferenceModel
+
+    module = _model()
+    rng = np.random.RandomState(7)
+    srv = MiniRedisServer().start()
+    try:
+        prod = RedisBroker(srv.host, srv.port, stream="t", group="g")
+        d = str(tmp_path)
+        est = _estimator(d, module=module)
+        src = StreamingXShards(
+            RedisBroker(srv.host, srv.port, stream="t", group="g"),
+            batch_size=BS, window_records=BS, poll_timeout_s=0.02)
+        tr = StreamingTrainer(est, src, d)
+
+        model = InferenceModel()
+        model.load_jax(module, {"params": jax.device_get(module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, DIM), np.float32))["params"])})
+        probe = np.ones((1, DIM), np.float32)
+        p0 = float(model.predict(probe)[0])
+        rel = StreamingReloader(model, d, poll_s=60, start_at=-1,
+                                stats=src.stats)
+
+        def serving_compiles():
+            return (int(model._cc.stats.counts("serving")["compiles"])
+                    if model._cc is not None else 0)
+
+        with trace.tracing(capacity=4096) as ring:
+            _fill(prod, rng, 0, BS, event_time=time.time())
+            tr.run(max_windows=1, idle_timeout_s=5.0)
+            warm_serving = serving_compiles()
+            # the freshness path: new records -> one more window -> reload
+            _fill(prod, rng, BS, 2 * BS, event_time=time.time())
+            tr.run(max_windows=1, idle_timeout_s=5.0)
+            assert rel.poll_now()
+            p1 = float(model.predict(probe)[0])
+
+        # 1. the served prediction moved within one window of the XADD
+        assert p1 != p0
+        # 2. zero recompiles after the warm window, both sides
+        assert tr.recompiles_after_warm() == 0
+        assert serving_compiles() == warm_serving
+        assert model.ckpt_stats().get("full_reloads", 0) == 0
+        # 3. ONE trace id across all five stages / four thread hops
+        by_name = {}
+        for s in ring.spans():
+            by_name.setdefault(s.name, set()).add(s.trace_id)
+        need = ("stream.ingest", "stream.assemble", "engine.dispatch",
+                "ckpt.write", "stream.reload")
+        chained = [t for t in by_name.get("stream.window", set())
+                   if all(t in by_name.get(n, set()) for n in need)]
+        assert chained, f"no complete chain; spans: {sorted(by_name)}"
+        # 4. freshness lag was measured from the manifest's event time
+        assert rel.freshness_samples and rel.freshness_samples[-1] < 60.0
+        p50, p99 = rel.freshness_percentiles()
+        assert p50 is not None and p99 >= p50
+        est.shutdown()
+    finally:
+        srv.stop()
+
+
+def test_streaming_stats_on_obs_registry():
+    from analytics_zoo_tpu.obs.registry import REGISTRY
+    from analytics_zoo_tpu.streaming import StreamingStats
+
+    stats = StreamingStats()
+    stats.add(records_in=3, windows=1, last_backlog=7)
+    stats.observe_freshness(1.5)
+    samples = {name: v for name, _labels, v in REGISTRY.collector_samples()
+               if name.startswith("zoo_streaming_")}
+    assert samples.get("zoo_streaming_records_in") == 3
+    assert samples.get("zoo_streaming_last_backlog") == 7
+    assert samples.get("zoo_streaming_last_freshness_lag_s") == 1.5
