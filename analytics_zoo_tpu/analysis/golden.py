@@ -84,6 +84,14 @@ _LEGS = [
     ("hierarchical", {"grad_bucket_mb": 0.001, "comms_hierarchy": True,
                       "comms_dcn_axis": 2},
      {"sharded_update": True}),
+    # the native int8 ring (PR 16): the DCN leg's reduce-scatter becomes
+    # collective_permute hops that really carry int8 payload + packed
+    # scales — hop count and wire bytes are pinned BYTE-EXACT (the lint
+    # rule runs with no simulated-wire exemption for this leg)
+    ("native_int8", {"grad_bucket_mb": 0.001, "comms_hierarchy": True,
+                     "comms_dcn_axis": 2, "allreduce_dtype": "int8",
+                     "allreduce_block": 32, "comms_native_int8": True},
+     {"sharded_update": True}),
 ]
 
 
@@ -179,6 +187,8 @@ def capture_contracts() -> Dict[str, Any]:
         counts = collective_counts(ops)
         rs_bytes = sum(op.operand_bytes for op in ops
                        if op.kind == "reduce_scatter")
+        cp_bytes = sum(op.operand_bytes for op in ops
+                       if op.kind == "collective_permute")
 
         donation = (fn._donate if hasattr(fn, "_donate")
                     else ((0, 2, 3) if est.engine.comms_resid is not None
@@ -187,12 +197,14 @@ def capture_contracts() -> Dict[str, Any]:
         entry: Dict[str, Any] = {
             "collectives": counts,
             "rs_wire_bytes": int(rs_bytes),
+            "cp_wire_bytes": int(cp_bytes),
             "donation": sorted(int(i) for i in donation),
         }
         if declared is not None:
             keep = ("buckets", "collectives_per_step", "wire_bytes_per_step",
                     "grad_leaves", "sharded_update", "wire_dtype",
-                    "grad_bytes_f32", "overlap", "segments", "hierarchy")
+                    "grad_bytes_f32", "overlap", "segments", "hierarchy",
+                    "native_int8", "native_hops")
             entry["declared"] = {k: declared[k] for k in keep
                                  if k in declared}
             hier = declared.get("hierarchy") or {}
@@ -234,6 +246,15 @@ def capture_contracts() -> Dict[str, Any]:
         dcn = int(entry["declared"]["hierarchy"]["dcn_axis"])
         contracts["hierarchical_dcn_shrink_ok"] = (
             entry["dcn_wire_bytes"] * dcn <= entry["ici_wire_bytes"])
+    # the native ring's acceptance, pinned: the measured permute bytes on
+    # the DCN leg EQUAL the declared packed wire cost (byte-exact — the
+    # simulated-wire exemption must never be what makes this leg pass)
+    if "native_int8" in contracts:
+        entry = contracts["native_int8"]
+        contracts["native_int8_byte_exact"] = (
+            entry["accounting_verified"]
+            and entry["dcn_wire_bytes"] == int(
+                entry["declared"]["hierarchy"]["dcn_wire_bytes_per_step"]))
     return contracts
 
 

@@ -33,7 +33,13 @@ silent until pod scale). Rules:
                       launch counts + wire bytes are checked per leg —
                       a regression that silently moves gradient bytes
                       from the fast links onto DCN fails the gate even
-                      when the total is unchanged.
+                      when the total is unchanged. The native int8 ring
+                      (``ZOO_COMMS_NATIVE_INT8``) is checked BYTE-EXACT:
+                      its ``collective_permute`` hops (classified by the
+                      connected components of their source->target
+                      pairs) must move exactly the packed payload+scale
+                      bytes the plan declares — no simulated-wire
+                      exemption.
 
 The hook (:func:`on_lowering`) is governed by ``ZOO_HLO_LINT``: ``warn``
 (default — log + collect into :func:`lint_report`), ``strict`` (raise
@@ -83,6 +89,17 @@ _ASYNC_COLLECTIVE_RE = re.compile(
     r"[\"% ]\s*(?:stablehlo\.|mhlo\.)?"
     r"(all[-_]reduce|reduce[-_]scatter|all[-_]gather|all[-_]to[-_]all|"
     r"collective[-_]permute)[-_](start|done)\"?\(")
+# hyphenated sync HLO text form: `%cp = s8[288]{0} collective-permute(...)`
+# — what a ppermute ring looks like in an HLO dump. The caller checks for
+# a preceding `=` (an op definition) so attribute/metadata strings can't
+# false-match; the async start/done forms are matched (and consumed)
+# first. Deliberately NO `=.*?` prefix in the pattern itself: the lazy
+# scan goes quadratic on the megabyte-long `dense<...>` constant lines of
+# real model lowerings (this regex runs on every line of every linted
+# module).
+_HLO_SYNC_RE = re.compile(
+    r"[\s)](all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)\(")
 _CONVERT_RE = re.compile(
     r"stablehlo\.convert\s.*:\s*\(tensor<([0-9x]*?)((?:f|bf|i|u|c)\d+)>\)"
     r"\s*->\s*tensor<[0-9x]*?((?:f|bf|i|u|c)\d+)>")
@@ -125,6 +142,56 @@ _GROUPS_DENSE_RE = re.compile(
     r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<(\d+)x(\d+)xi64>")
 # HLO text form: replica_groups={{0,1,2,3},{4,5,6,7}}
 _GROUPS_HLO_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+# collective_permute carries source_target_pairs instead of replica_groups.
+# stablehlo/mhlo: source_target_pairs = dense<[[0,1],[1,0]]> : tensor<Nx2xi64>
+_PAIRS_DENSE_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<([^>]*)>\s*:\s*tensor<\d+x2xi64>")
+# HLO text: source_target_pairs={{0,1},{1,0}}
+_PAIRS_HLO_RE = re.compile(
+    r"source_target_pairs=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+
+
+def _permute_group_shape(line: str) -> Optional[Tuple[int, int]]:
+    """Replica-group shape equivalent for a ``collective_permute``:
+    connected components of its undirected source->target pairs graph.
+    A per-DCN-group ring gives ``ici`` components of ``dcn`` members —
+    the same ``(ici, dcn)`` shape a grouped DCN collective declares — so
+    the ppermute wire classifies onto the same leg its bytes ride."""
+    m = _PAIRS_DENSE_RE.search(line)
+    if m is not None:
+        vals = [int(t) for t in re.findall(r"-?\d+", m.group(1))]
+        pairs = list(zip(vals[0::2], vals[1::2]))
+    else:
+        m = _PAIRS_HLO_RE.search(line)
+        if m is None:
+            return None
+        pairs = []
+        for g in re.findall(r"\{([^}]*)\}", m.group(1)):
+            t = [int(x) for x in g.split(",") if x.strip()]
+            if len(t) == 2:
+                pairs.append((t[0], t[1]))
+    if not pairs:
+        return None
+    parent: Dict[int, int] = {}
+
+    def _find(x: int) -> int:
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in pairs:
+        ra, rb = _find(a), _find(b)
+        if ra != rb:
+            parent[ra] = rb
+    comps: Dict[int, set] = {}
+    for d in parent:
+        comps.setdefault(_find(d), set()).add(d)
+    sizes = {len(c) for c in comps.values()}
+    if len(sizes) == 1:
+        return len(comps), sizes.pop()
+    return None
 
 
 def _group_shape(line: str) -> Optional[Tuple[int, int]]:
@@ -138,7 +205,7 @@ def _group_shape(line: str) -> Optional[Tuple[int, int]]:
                  for g in groups}
         if len(sizes) == 1:
             return len(groups), sizes.pop()
-    return None
+    return _permute_group_shape(line)
 
 
 def _tensor_bytes(types: str) -> int:
@@ -149,6 +216,27 @@ def _tensor_bytes(types: str) -> int:
             if d:
                 n *= int(d)
         total += n * _ELEM_BYTES.get(elem, 4)
+    return total
+
+
+# HLO text type tokens (`s8[288]{0}`) — byte accounting for modules that
+# arrive as an HLO dump rather than stablehlo (no `: (...) -> ...`
+# signature line to parse)
+_HLO_TYPE_RE = re.compile(
+    r"\b(pred|bf16|f16|f32|f64|s4|s8|s16|s32|s64|u8|u16|u32|u64|c64|c128)"
+    r"\[([0-9,]*)\]")
+_HLO_ELEM_ALIAS = {"pred": "i1", "s4": "i4", "s8": "i8", "s16": "i16",
+                   "s32": "i32", "s64": "i64"}
+
+
+def _hlo_text_bytes(segment: str) -> int:
+    total = 0
+    for elem, dims in _HLO_TYPE_RE.findall(segment):
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        total += n * _ELEM_BYTES.get(_HLO_ELEM_ALIAS.get(elem, elem), 4)
     return total
 
 
@@ -176,9 +264,21 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
                     sig_line = lines[j]
                     break
         sig = _SIG_RE.search(sig_line)
-        operand = _tensor_bytes(sig.group(1)) if sig else 0
-        after = sig_line[sig.end():] if sig else ""
-        return operand, _tensor_bytes(after)
+        if sig is not None:
+            return _tensor_bytes(sig.group(1)), _tensor_bytes(
+                sig_line[sig.end():])
+        # HLO text form: `%cp = s8[288]{0} collective-permute(s8[288] %p)`
+        # — result type after the `=`, operand types (when annotated)
+        # inside the call parens; an unannotated operand list falls back
+        # to the result type, byte-exact for the symmetric permute /
+        # all-to-all wire ops this path exists for.
+        line = lines[i]
+        lp = line.find("(")
+        head = line[:lp] if lp >= 0 else line
+        inner = line[lp + 1:line.find(")", lp)] if lp >= 0 else ""
+        result = _hlo_text_bytes(head)
+        operand = _hlo_text_bytes(inner) or result
+        return operand, result
 
     for i, line in enumerate(lines):
         m = _ASYNC_COLLECTIVE_RE.search(line)
@@ -192,10 +292,15 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
                                     group_shape=_group_shape(line)))
             continue
         m = _COLLECTIVE_RE.search(line)
+        if m is None:
+            m = _HLO_SYNC_RE.search(line)
+            if m is not None and "=" not in line[:m.start()]:
+                m = None                      # not an op definition
         if not m:
             continue
         operand, result = _signature(i)
-        out.append(CollectiveOp(kind=m.group(1), operand_bytes=operand,
+        out.append(CollectiveOp(kind=m.group(1).replace("-", "_"),
+                                operand_bytes=operand,
                                 result_bytes=result,
                                 group_shape=_group_shape(line)))
     return out
@@ -216,9 +321,10 @@ def collectives_by_axis(ops: Sequence[CollectiveOp], ici: int, dcn: int
     members, the DCN leg ``ici`` groups of ``dcn`` members; full-axis
     reductions (loss/clip bookkeeping) and group-less ops are
     ``global``. ``*_wire_bytes`` sums the gradient-exchange operands
-    (reduce-scatter + all-reduce; the param all-gather is accounted
-    separately, as everywhere in the comms plane). Shared by the
-    accounting rule, the golden capture and ``bench_comms``."""
+    (reduce-scatter + all-reduce + the native ring's collective-permute /
+    all-to-all hops; the param all-gather is accounted separately, as
+    everywhere in the comms plane). Shared by the accounting rule, the
+    golden capture and ``bench_comms``."""
     ici_shape, dcn_shape = (dcn, ici), (ici, dcn)
     out: Dict[str, Any] = {"ici": {}, "dcn": {}, "global": {},
                            "ici_wire_bytes": 0, "dcn_wire_bytes": 0,
@@ -233,8 +339,9 @@ def collectives_by_axis(ops: Sequence[CollectiveOp], ici: int, dcn: int
         else:
             leg = "global"
         out[leg][op.kind] = out[leg].get(op.kind, 0) + 1
-        if leg in ("ici", "dcn") and op.kind in ("reduce_scatter",
-                                                 "all_reduce"):
+        if leg in ("ici", "dcn") and op.kind in (
+                "reduce_scatter", "all_reduce", "collective_permute",
+                "all_to_all"):
             out[f"{leg}_wire_bytes"] += op.operand_bytes
     return out
 
@@ -433,25 +540,41 @@ class HloLinter:
                 _record_verified(label, counts, declared)
             return findings
         if buckets > 0:
+            native = bool(declared.get("native_int8"))
             rs, ag = counts.get("reduce_scatter", 0), counts.get(
                 "all_gather", 0)
-            if rs != buckets:
+            if native:
+                cp = counts.get("collective_permute", 0)
+                hops = int(declared.get("native_hops") or 0)
+                if cp != hops:
+                    _fail(f"native int8 ring launches {cp} "
+                          f"collective-permutes but accounting declares "
+                          f"{hops} ring hops")
+                if rs != 0:
+                    _fail(f"native int8 ring still launches {rs} "
+                          f"reduce-scatters — the ppermute hops must "
+                          f"replace them")
+            elif rs != buckets:
                 _fail(f"lowered program launches {rs} reduce-scatters but "
                       f"accounting declares {buckets} buckets")
             ag_expected = 1 if declared.get("sharded_update") else buckets
             if ag != ag_expected:
                 _fail(f"lowered program launches {ag} all-gathers but "
                       f"accounting declares {ag_expected}")
-            if declared.get("wire_dtype") in ("f32", "bf16"):
-                # int8 is a simulated wire (dequantized before an f32
-                # reduce — XLA has no int8-accumulating collective), so
-                # its declared native byte cost is not what the module
-                # moves; skip the byte equality there.
+            if declared.get("wire_dtype") in ("f32", "bf16") or native:
+                # simulated int8 (dequantized before an f32 reduce — XLA
+                # has no int8-accumulating collective) is the one exempt
+                # wire: its declared byte cost is not what the module
+                # moves. The NATIVE int8 ring is byte-exact — each hop's
+                # permute operand is exactly the int8 payload plus packed
+                # scales the accounting declares — so it is checked like
+                # f32/bf16.
                 measured = sum(op.operand_bytes for op in ops
-                               if op.kind == "reduce_scatter")
+                               if op.kind in ("reduce_scatter",
+                                              "collective_permute"))
                 declared_bytes = int(declared.get("wire_bytes_per_step", 0))
                 if measured != declared_bytes:
-                    _fail(f"reduce-scatter wire moves {measured} B/step in "
+                    _fail(f"gradient wire moves {measured} B/step in "
                           f"the lowered program but accounting declares "
                           f"{declared_bytes} B/step",
                           measured_rs_bytes=measured)
@@ -483,6 +606,8 @@ class HloLinter:
         buckets = int(declared["buckets"])
         sharded = bool(declared.get("sharded_update"))
         wire = declared.get("wire_dtype")
+        native = bool(declared.get("native_int8"))
+        hops = int(declared.get("native_hops") or 0)
         qdcn = bool(hier.get("quantize_dcn", True))
         ici_n, dcn_n = int(hier["ici_axis"]), int(hier["dcn_axis"])
         ax = collectives_by_axis(ops, ici_n, dcn_n)
@@ -505,12 +630,23 @@ class HloLinter:
                 return (ax["ici"].get(kind, 0) + ax["dcn"].get(kind, 0))
 
             rs_total, ag_total = _leg("reduce_scatter"), _leg("all_gather")
-            want_rs = 2 * buckets if sharded else buckets
+            want_rs = buckets if native else (2 * buckets if sharded
+                                              else buckets)
             if rs_total != want_rs:
                 _fail(f"hierarchical program launches {rs_total} grouped "
                       f"reduce-scatters but accounting declares {want_rs} "
                       f"(ici==dcn: legs indistinguishable by group shape)")
-            if sharded:
+            if native:
+                cp_total = _leg("collective_permute")
+                if cp_total != hops:
+                    _fail(f"native int8 DCN ring launches {cp_total} "
+                          f"grouped collective-permutes but accounting "
+                          f"declares {hops} ring hops (ici==dcn)")
+                want_ag = 2 if sharded else 2 * buckets
+                if ag_total != want_ag:
+                    _fail(f"native wire expected {want_ag} grouped "
+                          f"all-gathers, measured {ag_total} (ici==dcn)")
+            elif sharded:
                 if ag_total != 2:
                     _fail(f"two-stage param all-gather expected 2 grouped "
                           f"launches, measured {ag_total} (ici==dcn)")
@@ -524,7 +660,7 @@ class HloLinter:
                     _fail(f"ICI leg launches {ag_total} grouped "
                           f"all-gathers but accounting declares "
                           f"{buckets} buckets (ici==dcn)")
-            if wire != "int8":
+            if wire != "int8" or native:
                 measured = ax["ici_wire_bytes"] + ax["dcn_wire_bytes"]
                 want = (int(hier.get("ici_wire_bytes_per_step", 0))
                         + int(hier.get("dcn_wire_bytes_per_step", 0)))
@@ -538,7 +674,32 @@ class HloLinter:
         if rs_ici != buckets:
             _fail(f"ICI leg launches {rs_ici} reduce-scatters but "
                   f"accounting declares {buckets} buckets")
-        if sharded:
+        if native:
+            cp_dcn = ax["dcn"].get("collective_permute", 0)
+            if cp_dcn != hops:
+                _fail(f"DCN leg launches {cp_dcn} collective-permutes but "
+                      f"accounting declares {hops} native ring hops")
+            rs_dcn = ax["dcn"].get("reduce_scatter", 0)
+            ar_dcn = ax["dcn"].get("all_reduce", 0)
+            if rs_dcn or ar_dcn:
+                _fail(f"native int8 DCN ring still launches {rs_dcn} "
+                      f"reduce-scatters / {ar_dcn} all-reduces — the "
+                      f"ppermute hops must replace them")
+            ag_dcn = ax["dcn"].get("all_gather", 0)
+            ag_ici = ax["ici"].get("all_gather", 0)
+            if sharded:
+                if (ag_dcn, ag_ici) != (1, 1):
+                    _fail(f"two-stage param all-gather expected 1 DCN + "
+                          f"1 ICI launch, measured {ag_dcn} DCN + "
+                          f"{ag_ici} ICI")
+            else:
+                if ag_dcn != buckets:
+                    _fail(f"DCN ring-sum reassembly expected {buckets} "
+                          f"grouped all-gathers, measured {ag_dcn}")
+                if ag_ici != buckets:
+                    _fail(f"ICI leg launches {ag_ici} all-gathers but "
+                          f"accounting declares {buckets} buckets")
+        elif sharded:
             rs_dcn = ax["dcn"].get("reduce_scatter", 0)
             if rs_dcn != buckets:
                 _fail(f"DCN leg launches {rs_dcn} reduce-scatters but "
@@ -557,9 +718,11 @@ class HloLinter:
             if ag_ici != buckets:
                 _fail(f"ICI leg launches {ag_ici} all-gathers but "
                       f"accounting declares {buckets} buckets")
-        # wire-byte equality per leg. int8 is a simulated wire (values
-        # dequantized before the reduce), so byte equality is skipped for
-        # whichever leg carries it; bf16 really rides the collective.
+        # wire-byte equality per leg. SIMULATED int8 (values dequantized
+        # before the reduce) gets byte equality skipped for whichever leg
+        # carries it; bf16 really rides the collective, and the NATIVE
+        # int8 ring is byte-exact on the DCN leg — its permute operands
+        # are the packed int8 payload + scales the accounting declares.
         ici_quant = wire != "f32" and not qdcn
         dcn_quant = wire != "f32" and qdcn
         if not (wire == "int8" and ici_quant):
@@ -569,7 +732,7 @@ class HloLinter:
                 _fail(f"ICI leg moves {measured} B/step in the lowered "
                       f"program but accounting declares {want} B/step",
                       measured_ici_bytes=measured)
-        if not (wire == "int8" and dcn_quant):
+        if not (wire == "int8" and dcn_quant and not native):
             measured = ax["dcn_wire_bytes"]
             want = int(hier.get("dcn_wire_bytes_per_step", 0))
             if measured != want:

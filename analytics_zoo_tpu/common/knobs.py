@@ -72,7 +72,8 @@ _KNOBS = [
        "updates padded/N elements, then all-gathers params)."),
     _k("ZOO_ALLREDUCE_DTYPE", "str", "f32", "comms",
        "Gradient wire dtype: f32 | bf16 (real bf16 collective) | int8 "
-       "(block-scaled, simulated wire)."),
+       "(block-scaled; simulated wire by default, a real ppermute ring "
+       "with ZOO_COMMS_NATIVE_INT8=1)."),
     _k("ZOO_ALLREDUCE_BLOCK", "int", 256, "comms",
        "Elements per int8 quantization scale block."),
     _k("ZOO_COMMS_OVERLAP", "bool", False, "comms",
@@ -95,6 +96,13 @@ _KNOBS = [
        "With the hierarchical wire and a non-f32 allreduce dtype, "
        "quantize only the cross-host (DCN) leg — the ICI leg reduces "
        "exact f32. 0 = quantize the whole wire as the classic path does."),
+    _k("ZOO_COMMS_NATIVE_INT8", "bool", False, "comms",
+       "Native int8 collectives: replace the simulated int8 wire "
+       "(dequantize, then f32 reduce) with a shard_map ppermute ring "
+       "reduce-scatter whose hops really move int8 payloads + f32 block "
+       "scales — the full dp axis on the classic bucketed wire, each DCN "
+       "group on the hierarchical wire (ICI stays exact f32). Requires "
+       "ZOO_ALLREDUCE_DTYPE=int8."),
     _k("ZOO_EMBED_GRAD_MODE", "str", "auto", "comms",
        "Embedding gradient exchange: auto | dense | sparse."),
     # --- checkpoint plane ---------------------------------------------------

@@ -110,9 +110,10 @@ def snapshot_comms() -> int:
     backward–comms pipeline + the hierarchical two-level wire on the
     8-device simulated mesh — buckets, wire bytes/step, collective
     launches, bit-identity to flat psum, overlap stall attribution
-    (wall-time delta vs the post-backward wire, wire-byte parity), and
-    the ICI×DCN split (dp factored as 2 simulated hosts × 4 chips; DCN
-    wire bytes are the hierarchy's point)."""
+    (wall-time delta vs the post-backward wire, wire-byte parity), the
+    ICI×DCN split (dp factored as 2 simulated hosts × 4 chips; DCN
+    wire bytes are the hierarchy's point), and the native int8 ring's
+    hop count and packed DCN bytes (PR 16)."""
     _ensure_sim_devices()
     import time
 
@@ -169,6 +170,14 @@ def snapshot_comms() -> int:
     lho, _, _ = run_cfg({"grad_bucket_mb": 0.001, "comms_hierarchy": True,
                          "comms_dcn_axis": 2, "comms_overlap": True},
                         sharded_update=True)
+    # native int8 ring (PR 16): the DCN leg as a real collective-permute
+    # ring over block-scaled int8 payloads (quantize-where-expensive)
+    _, est_n, _ = run_cfg({"grad_bucket_mb": 0.001,
+                           "comms_hierarchy": True, "comms_dcn_axis": 2,
+                           "allreduce_dtype": "int8",
+                           "allreduce_block": 64,
+                           "comms_native_int8": True},
+                          sharded_update=True)
     snap = est.data_pipeline_stats()["comms"]
     osnap = est_o.data_pipeline_stats()["comms"]
     hsnap = est_h.data_pipeline_stats()["comms"]
@@ -191,6 +200,15 @@ def snapshot_comms() -> int:
         "dcn_wire_bytes": hh.get("dcn_wire_bytes_per_step"),
         "ici_wire_bytes": hh.get("ici_wire_bytes_per_step"),
         "bit_identical": lh == lho}
+    nsnap = est_n.data_pipeline_stats()["comms"]
+    nh = nsnap.get("hierarchy", {})
+    out["native_int8"] = {
+        "active": nsnap.get("native_int8"),
+        "hops": nsnap.get("native_hops"),
+        "dcn_wire_bytes": nh.get("dcn_wire_bytes_per_step"),
+        "dcn_vs_exact_shrink": round(
+            hh.get("dcn_wire_bytes_per_step", 0)
+            / max(nh.get("dcn_wire_bytes_per_step", 1), 1), 2)}
     return _emit("COMMS_PLANE", out)
 
 

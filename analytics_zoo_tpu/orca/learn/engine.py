@@ -557,7 +557,12 @@ class TrainEngine:
         # and the hierarchical classic-quantize variant); the DCN-only
         # variant's residual lives on the post-ICI chunk domain and is
         # folded in inside plan.hier_reduce instead
-        flat_resid = resid is not None and lo.resid_elems == lo.padded_total
+        # native classic wire: the ring folds the residual in per chunk
+        # slot itself, so the flat-domain pre-add below must not run
+        native_classic = plan.cfg.native_int8 and not plan.hierarchical
+        flat_resid = (resid is not None
+                      and lo.resid_elems == lo.padded_total
+                      and not native_classic)
         if plan.segplan is not None:
             bucket_vals = plan.segplan.bucket_values(grads)
             if flat_resid:
@@ -575,14 +580,21 @@ class TrainEngine:
         if plan.hierarchical:
             return self._comms_hier_exchange_update(
                 plan, params, opt_state, resid, bucket_vals)
-        shards, wires = plan.reduce_scatter_bucket_list(bucket_vals)
-        if resid is not None:
-            # elementwise subtract commutes with the bucket split, so the
-            # per-bucket form is bit-identical to (flat - concat(wires))
-            new_resid = jnp.concatenate(
-                [b - w for b, w in zip(bucket_vals, wires)])[None]
+        if native_classic:
+            shards, new_resid_row = plan.native_reduce_scatter_bucket_list(
+                bucket_vals, resid[0] if resid is not None else None)
+            new_resid = (new_resid_row[None] if new_resid_row is not None
+                         else resid)
         else:
-            new_resid = resid
+            shards, wires = plan.reduce_scatter_bucket_list(bucket_vals)
+            if resid is not None:
+                # elementwise subtract commutes with the bucket split, so
+                # the per-bucket form is bit-identical to
+                # (flat - concat(wires))
+                new_resid = jnp.concatenate(
+                    [b - w for b, w in zip(bucket_vals, wires)])[None]
+            else:
+                new_resid = resid
         scale = self._comms_clip_scale(shards)
         if plan.cfg.sharded_update:
             gshard = jnp.concatenate(shards) / n

@@ -26,9 +26,26 @@ tunable plane instead of whatever GSPMD happens to emit:
   residual of the previous step's quantization is added back before
   quantizing, so the compression error is corrected over time instead of
   accumulating. bf16 genuinely rides the collective; int8 is simulated-wire
-  on this jax (values are dequantized before the reduce because XLA exposes
-  no int8-accumulating allreduce) — byte accounting reports what a native
-  int8 wire would move.
+  by default on this jax (values are dequantized before the reduce because
+  XLA exposes no int8-accumulating allreduce) — byte accounting reports
+  what a native int8 wire would move.
+
+* **Native int8 ring** (``ZOO_COMMS_NATIVE_INT8``) — retires the simulated
+  int8 wire: the bucket reduce-scatter is decomposed into a shard_map
+  ``ppermute`` ring (EQuARX, arXiv:2506.17615 — block-scaled quantize,
+  exchange of int8 payloads + f32 scales packed into ONE int8 operand per
+  hop, dequant-accumulate on arrival). The local partial stays in a wide
+  f32 accumulator and the outgoing chunk is quantized fresh each hop
+  (bounded drift); error feedback is per chunk slot on the same residual
+  shapes as the simulated wire. On the classic path the ring spans the dp
+  axis; on the hierarchical wire it runs per DCN group — ICI stays exact
+  f32, only the cross-host hops carry int8, so DCN genuinely moves ~4x
+  fewer bytes than f32 (~2x vs bf16). Because the hops REALLY move int8,
+  hlo_lint's byte accounting is byte-exact (no simulated-wire exemption),
+  and the ring's different summation association means bit-identity with
+  the psum_scatter wire holds only where the math is exact (integer-
+  valued grads) — the EF drift bound is the contract, as for every
+  quantized wire.
 
 * **Hierarchical two-level wire** (``ZOO_COMMS_HIERARCHY``) — every leg
   above treats the dp axis as one flat ring, which is wrong at pod scale:
@@ -111,7 +128,9 @@ from . import collective as C
 
 __all__ = ["CommsConfig", "BucketLayout", "CommsPlan", "SegmentPlan",
            "build_layout", "hier_reduce_scatter_np", "hier_allreduce_np",
-           "hier_mean_np", "group_sum_np"]
+           "hier_mean_np", "group_sum_np", "quantize_wire",
+           "quantize_blocks", "dequantize_blocks", "pack_wire",
+           "unpack_wire", "native_ring_reduce_scatter_np"]
 
 WIRE_DTYPES = ("f32", "bf16", "int8")
 _WIRE_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
@@ -165,6 +184,18 @@ class CommsConfig:
                    are expensive. Off = the classic wire shape (bucket
                    quantized before the ICI leg; the DCN leg then moves
                    f32 host-partial sums).
+    native_int8  — ``allreduce_impl="native_int8"``
+                   (``ZOO_COMMS_NATIVE_INT8`` / config
+                   ``comms_native_int8``): replace the simulated int8
+                   exchange (dequantize, then f32 reduce) with a
+                   shard_map ``ppermute`` ring reduce-scatter whose hops
+                   really move int8 payloads + their f32 block scales.
+                   Classic path: the full-axis ring replaces the bucket
+                   reduce-scatter. Hierarchical path: the ICI leg stays
+                   exact f32 and the ring runs over each DCN group, so
+                   the cross-host exchange genuinely shrinks ~4x vs f32
+                   (~2x vs bf16). Requires ``wire_dtype="int8"`` (and,
+                   with ``hierarchy``, ``quantize_dcn`` on).
     """
 
     bucket_mb: float = 0.0
@@ -178,6 +209,7 @@ class CommsConfig:
     hierarchy: bool = False
     dcn_size: int = 0
     quantize_dcn: bool = True
+    native_int8: bool = False
 
     DEFAULT_BUCKET_MB = 4.0
 
@@ -198,6 +230,16 @@ class CommsConfig:
             raise ValueError(
                 "comms_dcn_axis only applies to the hierarchical wire — "
                 "set comms_hierarchy/ZOO_COMMS_HIERARCHY too")
+        if self.native_int8 and self.wire_dtype != "int8":
+            raise ValueError(
+                "comms_native_int8/ZOO_COMMS_NATIVE_INT8 is the int8 "
+                "wire's native implementation — set allreduce_dtype=int8 "
+                f"(got {self.wire_dtype!r})")
+        if self.native_int8 and self.hierarchy and not self.quantize_dcn:
+            raise ValueError(
+                "the native int8 ring rides the hierarchical wire's DCN "
+                "leg only (quantize-where-expensive) — it requires "
+                "comms_quantize_dcn on")
 
     @property
     def active(self) -> bool:
@@ -227,13 +269,18 @@ class CommsConfig:
         reduce-scatters sit in the dependence graph), so they salt the key
         exactly like the bucket layout does; the hierarchy knobs change
         every collective's replica groups and salt it the same way."""
-        return (f"comms:bucket_mb={self.effective_bucket_mb}:"
-                f"sharded={int(self.sharded_update)}:"
-                f"wire={self.wire_dtype}:block={self.block}:"
-                f"axis={self.axis}:overlap={int(self.overlap)}:"
-                f"segments={self.segments}:"
-                f"hier={int(self.hierarchy)}:dcn={self.dcn_size}:"
-                f"qdcn={int(self.quantize_dcn)}")
+        fp = (f"comms:bucket_mb={self.effective_bucket_mb}:"
+              f"sharded={int(self.sharded_update)}:"
+              f"wire={self.wire_dtype}:block={self.block}:"
+              f"axis={self.axis}:overlap={int(self.overlap)}:"
+              f"segments={self.segments}:"
+              f"hier={int(self.hierarchy)}:dcn={self.dcn_size}:"
+              f"qdcn={int(self.quantize_dcn)}")
+        # appended only when on, so every pre-existing fingerprint (and the
+        # executables cached under it) is byte-identical with the knob off
+        if self.native_int8:
+            fp += ":native=1"
+        return fp
 
     @classmethod
     def resolve(cls, config: Optional[Dict] = None,
@@ -274,10 +321,14 @@ class CommsConfig:
                         _env("ZOO_COMMS_QUANTIZE_DCN"))
         quantize_dcn = str(raw_q).lower() in ("1", "true", "yes", "on") \
             if raw_q is not None else True
+        raw_n = cfg.get("comms_native_int8", _env("ZOO_COMMS_NATIVE_INT8"))
+        native_int8 = str(raw_n).lower() in ("1", "true", "yes", "on") \
+            if raw_n is not None else False
         return cls(bucket_mb=bucket_mb, sharded_update=bool(sharded_update),
                    wire_dtype=wire, block=block, explicit=explicit,
                    overlap=overlap, segments=segments, hierarchy=hierarchy,
-                   dcn_size=dcn_size, quantize_dcn=quantize_dcn)
+                   dcn_size=dcn_size, quantize_dcn=quantize_dcn,
+                   native_int8=native_int8)
 
 
 # ---------------------------------------------------------------------------
@@ -323,13 +374,15 @@ class BucketLayout:
     ici: int = 1            # devices per host group along the dp axis
     dcn: int = 1            # host groups (1 = flat single-level wire)
     quantize_dcn: bool = True
+    native_int8: bool = False
 
     # -- construction --------------------------------------------------------
     @staticmethod
     def build(tree, n_dev: int, bucket_mb: float,
               wire_dtype: str = "f32", block: int = 256,
               ici: int = 1, dcn: int = 1,
-              quantize_dcn: bool = True) -> "BucketLayout":
+              quantize_dcn: bool = True,
+              native_int8: bool = False) -> "BucketLayout":
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         if not leaves:
             raise ValueError("comms plane: empty parameter tree")
@@ -367,6 +420,13 @@ class BucketLayout:
         # chunk, so that chunk must also split into whole scale blocks.
         if wire_dtype != "int8":
             align = n_dev
+        elif native_int8:
+            # the ring quantizes per HOP CHUNK (bucket/n_dev on the classic
+            # ring; the same bucket/(ici*dcn) sub-chunk on the DCN ring),
+            # so every chunk — not just every bucket — must split into
+            # whole scale blocks. n_dev*block is a multiple of both
+            # legacy int8 alignments, so the stricter rule subsumes them.
+            align = n_dev * block
         elif dcn > 1 and quantize_dcn:
             per_host = ici * block
             align = (n_dev * per_host) // math.gcd(n_dev, per_host)
@@ -399,15 +459,19 @@ class BucketLayout:
             shard_size=padded_total // int(n_dev),
             wire_dtype=wire_dtype, block=int(block),
             ici=ici if hier else int(n_dev), dcn=dcn if hier else 1,
-            quantize_dcn=bool(quantize_dcn))
+            quantize_dcn=bool(quantize_dcn),
+            native_int8=bool(native_int8))
 
     def signature(self) -> str:
         """Content hash of everything that changes the step's program or
         the checkpointed sharded-state layout."""
+        # extra fields are appended only when set, so every pre-existing
+        # layout signature is unchanged with the native wire off
+        extra = ("native_int8",) if self.native_int8 else ()
         h = hashlib.sha256(repr((
             self.shapes, self.dtypes, self.n_dev, self.bucket_sizes,
             self.wire_dtype, self.block, self.ici, self.dcn,
-            self.quantize_dcn)).encode())
+            self.quantize_dcn) + extra).encode())
         return h.hexdigest()[:16]
 
     # -- hierarchy -----------------------------------------------------------
@@ -549,6 +613,14 @@ class BucketLayout:
         if self.hierarchical:
             return (self.ici_wire_bytes_per_step()
                     + self.dcn_wire_bytes_per_step())
+        if self.native_int8:
+            # the ring's hops are the wire: per bucket, n_dev-1 ppermutes
+            # of one packed (int8 payload + f32 block scales) hop chunk.
+            # Byte-EXACT against the lowered module — each hop is a
+            # collective_permute whose operand is exactly this packed
+            # chunk, no simulated-wire convention left.
+            return sum((self.n_dev - 1) * self.native_hop_chunk_bytes(b)
+                       for b in self.bucket_sizes)
         per_elem = _WIRE_BYTES[self.wire_dtype]
         n = self.padded_total * per_elem
         if self.wire_dtype == "int8":
@@ -577,6 +649,11 @@ class BucketLayout:
         other leg accounts in)."""
         if not self.hierarchical:
             return 0
+        if self.native_int8:
+            # DCN-group ring: per bucket, dcn-1 ppermutes of one packed
+            # hop chunk (byte-exact, see wire_bytes_per_step)
+            return sum((self.dcn - 1) * self.native_hop_chunk_bytes(b)
+                       for b in self.bucket_sizes)
         chunk_total = self.padded_total // self.ici
         if self.wire_dtype == "f32" or not self.quantize_dcn:
             return chunk_total * 4
@@ -584,6 +661,25 @@ class BucketLayout:
         if self.wire_dtype == "int8":
             n += (chunk_total // self.block) * 4
         return n
+
+    def native_hop_chunk_bytes(self, bucket_size: int) -> int:
+        """Bytes one native-int8 ring hop moves for one bucket: the
+        ``bucket/n_dev`` hop chunk as int8 plus its f32 block scales,
+        packed into a single int8 ppermute operand. The classic ring
+        (full dp axis) and the DCN-group ring exchange the SAME chunk
+        size — the DCN ring's operand is the post-ICI ``bucket/ici``
+        chunk split ``dcn`` ways: ``bucket/(ici*dcn) == bucket/n_dev``."""
+        chunk = bucket_size // self.n_dev
+        return chunk + (chunk // self.block) * 4
+
+    def native_hops_per_step(self) -> int:
+        """collective_permute launches per step of the native int8 wire:
+        ring-size-1 hops per bucket (ring = the dp axis on the classic
+        wire, each DCN group on the hierarchical wire)."""
+        if not self.native_int8:
+            return 0
+        ring = self.dcn if self.hierarchical else self.n_dev
+        return len(self.bucket_sizes) * (ring - 1)
 
     def grad_bytes_f32(self) -> int:
         return self.total * 4
@@ -594,7 +690,8 @@ def build_layout(tree, n_dev: int, cfg: CommsConfig,
     return BucketLayout.build(tree, n_dev, cfg.effective_bucket_mb,
                               wire_dtype=cfg.wire_dtype, block=cfg.block,
                               ici=ici, dcn=dcn,
-                              quantize_dcn=cfg.quantize_dcn)
+                              quantize_dcn=cfg.quantize_dcn,
+                              native_int8=cfg.native_int8)
 
 
 # ---------------------------------------------------------------------------
@@ -742,6 +839,61 @@ def quantize_wire(x, wire_dtype: str, block: int):
     return (q.astype(jnp.float32) * safe).reshape(x.shape)
 
 
+def quantize_blocks(x, block: int):
+    """Block-scaled int8 quantization SPLIT for the native wire: returns
+    ``(q int8 (n,), scales f32 (n/block,))`` instead of the dequantized
+    f32 values — the pair that actually travels. Same math as
+    :func:`quantize_wire`'s int8 branch (max-abs/127 symmetric scales,
+    round-half-even, zero blocks carry scale 1.0 so nothing divides by
+    zero and padding dequantizes to exact 0.0):
+    ``dequantize_blocks(*quantize_blocks(x, b), b) ==
+    quantize_wire(x, "int8", b)`` bit for bit."""
+    blocks = x.reshape(-1, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / safe), -127, 127).astype(jnp.int8)
+    return q.reshape(x.shape), safe[:, 0]
+
+
+def dequantize_blocks(q, scales, block: int):
+    """Inverse of :func:`quantize_blocks` up to quantization error."""
+    return (q.astype(jnp.float32).reshape(-1, block)
+            * scales[:, None]).reshape(-1)
+
+
+def pack_wire(q, scales):
+    """(int8 payload, f32 block scales) -> ONE flat int8 hop operand:
+    the scales are bitcast to 4 int8 bytes each and appended, so every
+    ring hop is a single ``collective_permute`` whose operand dtype and
+    byte count ARE the declared wire cost — what hlo_lint's byte-exact
+    accounting measures."""
+    sb = lax.bitcast_convert_type(scales, jnp.int8).reshape(-1)
+    return jnp.concatenate([q, sb])
+
+
+def unpack_wire(packed, n_elems: int, block: int):
+    """Inverse of :func:`pack_wire` for a hop chunk of ``n_elems``."""
+    q = packed[:n_elems]
+    scales = lax.bitcast_convert_type(
+        packed[n_elems:].reshape(-1, 4), jnp.float32)
+    return q, scales
+
+
+def quantize_blocks_np(x: np.ndarray, block: int):
+    """Numpy host twin of :func:`quantize_blocks` — bit-exact (np.round
+    and jnp.round both round half to even)."""
+    blocks = np.asarray(x, np.float32).reshape(-1, block)
+    scale = np.abs(blocks).max(axis=1, keepdims=True) / np.float32(127.0)
+    safe = np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.round(blocks / safe), -127, 127).astype(np.int8)
+    return q.reshape(-1), safe[:, 0]
+
+
+def dequantize_blocks_np(q: np.ndarray, scales: np.ndarray, block: int):
+    return (q.astype(np.float32).reshape(-1, block)
+            * scales[:, None].astype(np.float32)).reshape(-1)
+
+
 # ---------------------------------------------------------------------------
 # numpy host twins of the two-level wire (tests, tooling, and the contract
 # that the decomposition's MATH is checkable on any host — including ones
@@ -819,6 +971,70 @@ def hier_mean_np(stacked: np.ndarray, ici: int, dcn: int) -> np.ndarray:
     return hier_allreduce_np(stacked, ici, dcn)[0] / (ici * dcn)
 
 
+def native_ring_reduce_scatter_np(stacked: np.ndarray, block: int,
+                                  resid: Optional[np.ndarray] = None,
+                                  groups=None):
+    """Host twin of the native int8 ring reduce-scatter: same quantize
+    math, same accumulation order, wide-f32 local accumulate, fresh
+    quantize per hop, per-chunk-slot error feedback. BIT-exact against
+    the shard_map ``ppermute`` implementation wherever the quantization
+    is exact (block-constant ``127*k`` values, zero blocks, the planted
+    exact cases the tests pin); for generic floats the device may
+    contract the dequant multiply into the accumulate as one FMA — a
+    rounding numpy cannot reproduce — so the twin agrees to within an
+    ulp per hop there, not bitwise.
+
+    ``stacked`` is ``(n_dev, L)`` per-device operand rows; ``groups`` is
+    the list of rings (global device ids in ring order; default one ring
+    spanning all rows); ``resid`` is the optional ``(n_dev, L)``
+    per-chunk-slot EF residual. Returns ``(owned, new_resid)`` where
+    ``owned`` is ``(n_dev, L // ring_size)`` — ring position ``p`` ends
+    holding the full sum of chunk ``p``, the same ownership as the tiled
+    ``psum_scatter`` it replaces."""
+    stacked = np.asarray(stacked, np.float32)
+    n_dev, length = stacked.shape
+    if groups is None:
+        groups = [list(range(n_dev))]
+    n = len(groups[0])
+    csize = length // n
+    owned = np.zeros((n_dev, csize), np.float32)
+    new_resid = np.zeros_like(stacked) if resid is not None else None
+
+    def chunk(vec, c):
+        return vec[c * csize:(c + 1) * csize]
+
+    for g in groups:
+        if n == 1:               # degenerate ring: nothing moves
+            owned[g[0]] = stacked[g[0]]
+            continue
+
+        def quant_send(p, c, value):
+            pre = value if resid is None \
+                else value + chunk(np.asarray(resid[g[p]], np.float32), c)
+            q, scales = quantize_blocks_np(pre, block)
+            wire = dequantize_blocks_np(q, scales, block)
+            if new_resid is not None:
+                new_resid[g[p], c * csize:(c + 1) * csize] = pre - wire
+            return q, scales
+
+        send = [quant_send(p, (p - 1) % n, chunk(stacked[g[p]], (p - 1) % n))
+                for p in range(n)]
+        for t in range(1, n):
+            recv = [send[(p - 1) % n] for p in range(n)]
+            nxt = [None] * n
+            for p in range(n):
+                q, scales = recv[p]
+                v = dequantize_blocks_np(q, scales, block)
+                c = (p - 1 - t) % n
+                acc = v + chunk(stacked[g[p]], c)
+                if t < n - 1:
+                    nxt[p] = quant_send(p, c, acc)
+                else:
+                    owned[g[p]] = acc
+            send = nxt
+    return owned, new_resid
+
+
 # ---------------------------------------------------------------------------
 # the plan — everything the traced step needs, all shapes static
 # ---------------------------------------------------------------------------
@@ -856,18 +1072,33 @@ class CommsPlan:
         lo, cfg = self.layout, self.cfg
         bucketed = cfg.effective_bucket_mb > 0
         n_b = len(lo.bucket_sizes)
+        hops = lo.native_hops_per_step()
         if lo.hierarchical:
-            # per bucket: ICI reduce-scatter + DCN exchange (allreduce, or
-            # reduce-scatter under ZeRO-1) + (unsharded) ICI all-gather;
-            # the sharded update replaces the per-bucket gathers with the
-            # two-stage (DCN then ICI) param all-gather
-            collectives = (2 * n_b + 2 if cfg.sharded_update
-                           else 3 * n_b)
+            if cfg.native_int8:
+                # per bucket: ICI reduce-scatter + dcn-1 ring hops; the
+                # unsharded DCN "allreduce" decomposes as ring + per-bucket
+                # DCN all-gather before the ICI all-gather
+                collectives = (n_b + hops + 2 if cfg.sharded_update
+                               else n_b + hops + 2 * n_b)
+            else:
+                # per bucket: ICI reduce-scatter + DCN exchange (allreduce,
+                # or reduce-scatter under ZeRO-1) + (unsharded) ICI
+                # all-gather; the sharded update replaces the per-bucket
+                # gathers with the two-stage (DCN then ICI) param
+                # all-gather
+                collectives = (2 * n_b + 2 if cfg.sharded_update
+                               else 3 * n_b)
         elif bucketed:
-            # one reduce-scatter + one all-gather per bucket (the sharded
-            # update folds the grad all-gather into the param all-gather)
-            collectives = (2 * n_b if not cfg.sharded_update
-                           else n_b + 1)
+            if cfg.native_int8:
+                # n_dev-1 ring hops replace each bucket's reduce-scatter
+                collectives = (hops + 1 if cfg.sharded_update
+                               else hops + n_b)
+            else:
+                # one reduce-scatter + one all-gather per bucket (the
+                # sharded update folds the grad all-gather into the param
+                # all-gather)
+                collectives = (2 * n_b if not cfg.sharded_update
+                               else n_b + 1)
         else:
             collectives = len(lo.sizes)      # one psum per grad leaf
         out = {
@@ -884,6 +1115,11 @@ class CommsPlan:
             "overlap": cfg.overlap,
             "segments": self.segplan.n_segments if self.segplan else 0,
         }
+        if cfg.native_int8:
+            # present only when the native wire is on, so every existing
+            # summary (and the goldens pinning them) is unchanged
+            out["native_int8"] = True
+            out["native_hops"] = hops
         if cfg.hierarchy:
             out["hierarchy"] = {
                 "ici_axis": lo.ici, "dcn_axis": lo.dcn,
@@ -932,6 +1168,86 @@ class CommsPlan:
                 shards.append(C.reduce_scatter(wire, self.axis))
                 wires.append(wire)
         return shards, wires
+
+    # -- native int8 ring (per-replica view) ---------------------------------
+    def _native_exchange(self, x, resid_seg, perm, n_ring, pos):
+        """One operand's native int8 ring reduce-scatter: ``n_ring - 1``
+        ``ppermute`` hops, each really moving one packed (int8 payload +
+        f32 block scales) hop chunk. ``perm`` is the global-index ring
+        (pairs within each group ride that group's ring), ``pos`` this
+        replica's ring position. Returns ``(owned, new_resid_seg)`` —
+        position ``p`` ends holding the full sum of chunk ``p``, the same
+        ownership as the tiled ``psum_scatter`` it replaces.
+
+        Variant choice (documented in docs/performance_notes.md): the
+        local partial is kept in a WIDE f32 accumulator and the outgoing
+        chunk is quantized fresh from it each hop — per-hop drift is one
+        quantization of the running sum, bounded like the simulated
+        wire's, instead of compounding requantize-of-requantized error.
+        Error feedback is per chunk SLOT: each replica's residual slice
+        ``c`` carries the error of its last quantization while forwarding
+        chunk ``c``, added back the next time it quantizes that slot —
+        the same EF-SGD telescoping as the flat wire, on the same
+        residual shape."""
+        cfg = self.cfg
+        length = x.shape[0]
+        csize = length // n_ring
+        block = cfg.block
+        if n_ring == 1:              # degenerate ring: nothing moves
+            return x, (jnp.zeros_like(resid_seg)
+                       if resid_seg is not None else None)
+
+        def seg(vec, c):
+            return lax.dynamic_slice(vec, (c * csize,), (csize,))
+
+        new_resid = (jnp.zeros_like(resid_seg)
+                     if resid_seg is not None else None)
+
+        def quant_send(c, value):
+            nonlocal new_resid
+            pre = value if resid_seg is None else value + seg(resid_seg, c)
+            q, scales = quantize_blocks(pre, block)
+            if new_resid is not None:
+                wire = dequantize_blocks(q, scales, block)
+                new_resid = lax.dynamic_update_slice(
+                    new_resid, pre - wire, (c * csize,))
+            return pack_wire(q, scales)
+
+        c = (pos - 1) % n_ring
+        packed = quant_send(c, seg(x, c))
+        acc = None
+        for t in range(1, n_ring):
+            arrived = lax.ppermute(packed, self.axis, perm=perm)
+            q, scales = unpack_wire(arrived, csize, block)
+            v = dequantize_blocks(q, scales, block)
+            c = (pos - 1 - t) % n_ring
+            acc = v + seg(x, c)      # wide f32 local accumulate
+            if t < n_ring - 1:
+                packed = quant_send(c, acc)
+        return acc, new_resid
+
+    def native_reduce_scatter_bucket_list(self, bucket_vals, resid_row):
+        """Classic-path native int8 wire: a full-dp-axis ring per bucket
+        replaces :meth:`reduce_scatter_bucket_list`'s quantize +
+        ``psum_scatter``. ``resid_row`` is this replica's flat-domain
+        (``padded_total``) EF residual — the ring handles the add-back
+        and error capture per chunk slot, so the caller must NOT pre-add
+        it. Returns ``(shards, new_resid_row)``."""
+        lo = self.layout
+        n = lo.n_dev
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        pos = C.axis_index(self.axis)
+        resid_bs = (lo.buckets(resid_row) if resid_row is not None
+                    else [None] * len(bucket_vals))
+        shards, new_resids = [], []
+        for bucket, r in zip(bucket_vals, resid_bs):
+            owned, nr = self._native_exchange(bucket, r, perm, n, pos)
+            shards.append(owned)
+            if nr is not None:
+                new_resids.append(nr)
+        new_resid_row = (jnp.concatenate(new_resids) if new_resids
+                         else None)
+        return shards, new_resid_row
 
     def gather_buckets(self, shards) -> Any:
         """Per-bucket summed shards -> full flat summed vector."""
@@ -1018,6 +1334,33 @@ class CommsPlan:
         if flat_wires is not None and cfg.wire_dtype == "bf16":
             ici_chunks = [c.astype(jnp.float32) for c in ici_chunks]
         new_resid_row = None
+        if cfg.native_int8:
+            # native int8 DCN leg: the ICI leg above reduced exact f32;
+            # each bucket's post-ICI chunk now rides a ppermute ring over
+            # its DCN group — dcn-1 hops of genuine int8 payload + f32
+            # block scales, per-chunk-slot EF on the chunk-domain
+            # residual. Unsharded mode reassembles the global chunk with
+            # a per-bucket DCN-group all-gather of the exact f32 ring
+            # sums (gather legs stay exact, as everywhere in the plane).
+            perm = [(g[j], g[(j + 1) % lo.dcn]) for g in self.dcn_groups
+                    for j in range(lo.dcn)]
+            pos = C.axis_index(self.axis) // lo.ici
+            chunk_resids = (lo.chunk_buckets(resid_row)
+                            if resid_row is not None
+                            else [None] * len(ici_chunks))
+            out, new_rs = [], []
+            for chunk, r in zip(ici_chunks, chunk_resids):
+                owned, nr = self._native_exchange(chunk, r, perm,
+                                                  lo.dcn, pos)
+                if not cfg.sharded_update:
+                    owned = C.all_gather(owned, self.axis,
+                                         axis_index_groups=self.dcn_groups)
+                out.append(owned)
+                if nr is not None:
+                    new_rs.append(nr)
+            if new_rs:
+                new_resid_row = jnp.concatenate(new_rs)
+            return out, new_resid_row, None
         if cfg.quantized and lo.quantize_dcn:
             pre = (ici_chunks if resid_row is None else
                    [c + r for c, r in zip(ici_chunks,

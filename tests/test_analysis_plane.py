@@ -170,6 +170,62 @@ def test_parse_collectives_counts_async_start_done_pairs_once():
         _ASYNC_MODULE, label="train", declared=declared) == []
 
 
+_PERMUTE_MODULE = textwrap.dedent("""\
+    module @jit_step_ring {
+      func.func public @main(%arg0: tensor<288xi8>) -> tensor<288xi8> {
+        %0 = "stablehlo.collective_permute"(%arg0) <{source_target_pairs = dense<[[0, 4], [4, 0], [1, 5], [5, 1], [2, 6], [6, 2], [3, 7], [7, 3]]> : tensor<8x2xi64>}> : (tensor<288xi8>) -> tensor<288xi8>
+        %1 = "stablehlo.all_to_all"(%0) <{replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>}> : (tensor<288xi8>) -> tensor<288xi8>
+        return %1 : tensor<288xi8>
+      }
+    }
+    """)
+
+
+def test_parse_collectives_recognizes_permute_and_all_to_all():
+    """PR 16: a ppermute-based wire must be visible to the accounting
+    gate. stablehlo sync, async start/done, and hyphenated HLO-text forms
+    all count with dtype-true (int8, not x4) bytes, and a permute's
+    source->target pairs classify it onto a leg the way replica_groups
+    classify a reduce-scatter."""
+    from analytics_zoo_tpu.analysis.hlo_lint import collectives_by_axis
+    ops = parse_collectives(_PERMUTE_MODULE)
+    assert sorted(op.kind for op in ops) == ["all_to_all",
+                                             "collective_permute"]
+    cp = next(op for op in ops if op.kind == "collective_permute")
+    assert cp.operand_bytes == 288            # int8: one byte per element
+    # 4 disjoint 2-cycles == the (ici=4, dcn=2) DCN-leg group shape
+    assert cp.group_shape == (4, 2)
+    a2a = next(op for op in ops if op.kind == "all_to_all")
+    assert a2a.operand_bytes == 288 and a2a.group_shape == (1, 8)
+    by = collectives_by_axis(ops, ici=4, dcn=2)
+    assert by["dcn"]["collective_permute"] == 1
+    assert by["dcn_wire_bytes"] == 288        # the a2a is global, not DCN
+    assert by["global"]["all_to_all"] == 1
+    # async start/done pair = ONE launch (what the latency-hiding
+    # scheduler emits when the ring hop overlaps compute)
+    async_txt = (
+        '%0 = "stablehlo.collective_permute_start"(%arg0) '
+        '<{source_target_pairs = dense<[[0, 1], [1, 0]]> : tensor<2x2xi64>}>'
+        ' : (tensor<96xi8>) -> tensor<96xi8>\n'
+        '%1 = "stablehlo.collective_permute_done"(%0) '
+        ': (tensor<96xi8>) -> tensor<96xi8>\n')
+    ops = parse_collectives(async_txt)
+    assert [op.kind for op in ops] == ["collective_permute"]
+    assert ops[0].operand_bytes == 96 and ops[0].group_shape == (1, 2)
+    # hyphenated HLO text: bytes come from the s8[...] type tokens (no
+    # stablehlo tensor<> signature to read), sync and start/done alike
+    hlo = ("%cp = s8[288]{0} collective-permute(s8[288]{0} %p), "
+           "source_target_pairs={{0,4},{4,0},{1,5},{5,1},"
+           "{2,6},{6,2},{3,7},{7,3}}\n"
+           "%cps = s8[96] collective-permute-start(s8[96] %q), "
+           "source_target_pairs={{0,1},{1,0}}\n"
+           "%cpd = s8[96] collective-permute-done(%cps)\n")
+    ops = parse_collectives(hlo)
+    assert [op.kind for op in ops] == ["collective_permute"] * 2
+    assert ops[0].operand_bytes == 288 and ops[0].group_shape == (4, 2)
+    assert ops[1].operand_bytes == 96 and ops[1].group_shape == (1, 2)
+
+
 def test_comms_accounting_rule_verifies_and_catches_drift():
     declared = {"buckets": 1, "sharded_update": True, "wire_dtype": "f32",
                 "wire_bytes_per_step": 840 * 4}
@@ -296,6 +352,12 @@ def test_golden_gate_fails_on_injected_collective_regression():
     # per-bucket reduce-scatters into one) must fail field-level too
     tampered["overlapped"]["collectives"]["reduce_scatter"] = 1
     tampered["overlapped_wire_matches_bucketed"] = False
+    # PR 16: the native int8 leg's hop count and wire bytes are pinned —
+    # a lost ring hop or a widened payload must fail field-level
+    tampered["native_int8"]["collectives"]["collective_permute"] -= 1
+    tampered["native_int8"]["cp_wire_bytes"] += 4
+    tampered["native_int8"]["declared"]["native_hops"] += 1
+    tampered["native_int8_byte_exact"] = False
     ok, delta = golden_mod.check(measured=tampered)
     assert not ok
     joined = "\n".join(delta)
@@ -303,6 +365,10 @@ def test_golden_gate_fails_on_injected_collective_regression():
     assert "bucketed_sharded.rs_wire_bytes" in joined
     assert "overlapped.collectives.reduce_scatter" in joined
     assert "overlapped_wire_matches_bucketed" in joined
+    assert "native_int8.collectives.collective_permute" in joined
+    assert "native_int8.cp_wire_bytes" in joined
+    assert "native_int8.declared.native_hops" in joined
+    assert "native_int8_byte_exact" in joined
     # the delta is field-level and readable: golden -> measured
     assert any("->" in line for line in delta)
 
@@ -322,6 +388,31 @@ def test_overlapped_golden_leg_contract():
         contracts["bucketed_sharded"]["rs_wire_bytes"]
     assert contracts["overlapped_wire_matches_bucketed"] is True
     assert leg["accounting_verified"] is True
+
+
+def test_native_int8_golden_leg_contract():
+    """PR 16: the committed native-int8 contract. The DCN leg is a pure
+    collective-permute ring — (dcn-1) hops per bucket, NO reduce-scatter
+    or all-reduce — and the measured permute bytes equal the declared
+    packed payload+scale cost exactly: no simulated-wire exemption left."""
+    contracts = golden_mod.load_goldens()
+    leg = contracts["native_int8"]
+    d = leg["declared"]
+    assert d["native_int8"] is True and d["wire_dtype"] == "int8"
+    hier = d["hierarchy"]
+    assert hier["quantize_dcn"] is True
+    assert d["native_hops"] == d["buckets"] * (hier["dcn_axis"] - 1)
+    assert leg["by_axis"]["dcn"]["collective_permute"] == d["native_hops"]
+    assert "reduce_scatter" not in leg["by_axis"]["dcn"]
+    assert "all_reduce" not in leg["by_axis"]["dcn"]
+    # byte-exact: measured permute operands == declared DCN wire cost
+    assert leg["cp_wire_bytes"] == hier["dcn_wire_bytes_per_step"]
+    assert leg["dcn_wire_bytes"] == leg["cp_wire_bytes"]
+    assert contracts["native_int8_byte_exact"] is True
+    assert leg["accounting_verified"] is True
+    # the int8 hops genuinely shrink the DCN leg: well under the f32
+    # reduce-scatter bytes the ICI leg moves for the same gradients
+    assert leg["cp_wire_bytes"] * 3 < hier["ici_wire_bytes_per_step"]
 
 
 # ---------------------------------------------------------------------------

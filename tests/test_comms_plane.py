@@ -1050,3 +1050,327 @@ def test_hier_accounting_verified_and_tamper(orca_context):
     findings = HloLinter().lint_text(text, label="train", declared=bad)
     assert findings and any("DCN leg moves" in f.message
                             for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# PR 16: native quantized collectives — the int8 ring that really moves bytes
+# ---------------------------------------------------------------------------
+def _native_cfg(**extra):
+    return {"grad_bucket_mb": 0.001, "allreduce_dtype": "int8",
+            "allreduce_block": 64, "comms_native_int8": True, **extra}
+
+
+def _native_hier_cfg(**extra):
+    return _native_cfg(comms_hierarchy=True, comms_dcn_axis=2, **extra)
+
+
+def _build_lowered(cfg, **kw):
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                       config={"steps_per_dispatch": 1, **cfg}, **kw)
+    it = data_to_iterator(dict(_data()), 32, est.mesh, None, None,
+                          shuffle=False, config=est.config)
+    batch = next(it.epoch(shuffle=False, prefetch=False))
+    est.engine.build(tuple(np.asarray(a) for a in batch.x))
+    fn = est.engine.ensure_jit_train()
+    text = fn.lower(*est.engine.train_step_args(batch)).as_text()
+    return est, text, est.engine.comms_snapshot()
+
+
+def test_native_layout_alignment_and_validation(orca_context):
+    """Every ring hop chunk (bucket / n_dev) must split into whole scale
+    blocks — the native alignment (n_dev*block) subsumes both legacy int8
+    alignments — and the ring is program shape: it salts the layout
+    identity and is rejected without the int8 wire it implements."""
+    tree = _random_tree()
+    lo = build_layout(tree, 8, CommsConfig(
+        bucket_mb=0.0005, wire_dtype="int8", block=64, native_int8=True))
+    assert all(b % (8 * 64) == 0 for b in lo.bucket_sizes)
+    lo_sim = build_layout(tree, 8, CommsConfig(
+        bucket_mb=0.0005, wire_dtype="int8", block=64))
+    assert lo.signature() != lo_sim.signature()
+    # packed hop operand = int8 payload + 4 bitcast scale bytes per block
+    for b in lo.bucket_sizes:
+        chunk = b // 8
+        assert lo.native_hop_chunk_bytes(b) == chunk + (chunk // 64) * 4
+    assert lo.native_hops_per_step() == len(lo.bucket_sizes) * 7
+    assert lo.wire_bytes_per_step() == sum(
+        7 * lo.native_hop_chunk_bytes(b) for b in lo.bucket_sizes)
+    # hierarchical: only the DCN ring hops (dcn - 1 per bucket) are native
+    lo_h = build_layout(tree, 8, CommsConfig(
+        bucket_mb=0.0005, wire_dtype="int8", block=64, native_int8=True,
+        hierarchy=True, dcn_size=2), ici=4, dcn=2)
+    assert lo_h.native_hops_per_step() == len(lo_h.bucket_sizes) * 1
+    assert lo_h.dcn_wire_bytes_per_step() == sum(
+        lo_h.native_hop_chunk_bytes(b) for b in lo_h.bucket_sizes)
+    # native is the int8 wire's implementation, and rides the DCN leg only
+    with pytest.raises(ValueError, match="native"):
+        CommsConfig(native_int8=True)
+    with pytest.raises(ValueError, match="native"):
+        CommsConfig(native_int8=True, wire_dtype="int8", hierarchy=True,
+                    dcn_size=2, quantize_dcn=False)
+
+
+def test_native_knob_resolution(orca_context, monkeypatch):
+    monkeypatch.setenv("ZOO_COMMS_NATIVE_INT8", "1")
+    monkeypatch.setenv("ZOO_ALLREDUCE_DTYPE", "int8")
+    cfg = CommsConfig.resolve({})
+    assert cfg.active and cfg.native_int8 and cfg.wire_dtype == "int8"
+    assert cfg.fingerprint().endswith(":native=1")
+    # config dict wins over env
+    assert not CommsConfig.resolve({"comms_native_int8": False}).native_int8
+    monkeypatch.delenv("ZOO_COMMS_NATIVE_INT8")
+    monkeypatch.delenv("ZOO_ALLREDUCE_DTYPE")
+    # off keeps every pre-existing fingerprint byte-identical (cached
+    # executables stay valid)
+    assert "native" not in CommsConfig.resolve(
+        {"grad_bucket_mb": 0.001, "allreduce_dtype": "int8"}).fingerprint()
+
+
+def test_native_quantize_pack_roundtrip(orca_context):
+    from analytics_zoo_tpu.parallel.comms import (
+        dequantize_blocks, dequantize_blocks_np, pack_wire,
+        quantize_blocks, quantize_blocks_np, quantize_wire, unpack_wire)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(512).astype(np.float32))
+    q, s = quantize_blocks(x, 64)
+    # the split form IS the simulated wire's math, bit for bit
+    assert (np.asarray(dequantize_blocks(q, s, 64)) ==
+            np.asarray(quantize_wire(x, "int8", 64))).all()
+    # pack -> one int8 hop operand (payload + 4 B/block of bitcast
+    # scales); unpack round-trips both exactly
+    packed = pack_wire(q, s)
+    assert packed.dtype == jnp.int8 and packed.shape == (512 + 8 * 4,)
+    q2, s2 = unpack_wire(packed, 512, 64)
+    assert (np.asarray(q2) == np.asarray(q).reshape(-1)).all()
+    assert (np.asarray(s2) == np.asarray(s)).all()
+    # numpy twins are bit-exact (np.round and jnp.round both half-even)
+    qn, sn = quantize_blocks_np(np.asarray(x), 64)
+    assert (qn == np.asarray(q).reshape(-1)).all()
+    assert (sn == np.asarray(s)).all()
+    assert (dequantize_blocks_np(qn, sn, 64) ==
+            np.asarray(dequantize_blocks(q, s, 64))).all()
+    # zero blocks carry scale 1.0: nothing divides by zero and padding
+    # dequantizes to exact 0.0
+    qz, sz = quantize_blocks(jnp.zeros(128), 64)
+    assert (np.asarray(qz) == 0).all() and (np.asarray(sz) == 1.0).all()
+    # ragged final block (a bucket's padded tail): the tail zeros share
+    # the last real values' scale and come back as exact zeros
+    tail = jnp.concatenate([jnp.asarray(rng.randn(40), jnp.float32),
+                            jnp.zeros(24)])
+    qt, st = quantize_blocks(tail, 64)
+    deq = np.asarray(dequantize_blocks(qt, st, 64))
+    assert (deq[40:] == 0).all() and np.abs(deq[:40]).max() > 0
+
+
+def test_native_ring_matches_twin_and_exact_reduce(orca_context):
+    """The ring's MATH, checked two ways on one bucket: generic floats
+    match the numpy host twin to within an ulp per hop (the device may
+    contract dequant-multiply + accumulate into one FMA; everything else
+    — quantize math, accumulation order, EF capture — is identical), and
+    where the quantization is exact (block-constant 127*k values, so
+    every scale is the integer k) the ring equals the exact linear
+    reduce-scatter it replaces BITWISE, with a residual of exact zero."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from analytics_zoo_tpu.parallel._compat import shard_map
+    from analytics_zoo_tpu.parallel.comms import (
+        native_ring_reduce_scatter_np)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    b, block = 512, 64
+    tree = {"w": np.zeros(b, np.float32)}
+    cfg = CommsConfig(bucket_mb=4.0, wire_dtype="int8", block=block,
+                      native_int8=True)
+    lo = build_layout(tree, 8, cfg)
+    assert lo.bucket_sizes == (b,)
+    plan = CommsPlan(cfg, lo)
+
+    def ring_body(v, r):
+        shards, nr = plan.native_reduce_scatter_bucket_list([v[0]], r[0])
+        return shards[0], nr
+
+    ring = jax.jit(shard_map(
+        ring_body, mesh=mesh, in_specs=(P("dp", None), P("dp", None)),
+        out_specs=(P("dp"), P("dp")), check_vma=False))
+
+    rng = np.random.RandomState(3)
+    stacked = (rng.rand(8, b).astype(np.float32) - 0.5) * 3
+    resid = (rng.randn(8, b) * 1e-3).astype(np.float32)
+    got, got_r = ring(stacked, resid)
+    want, want_r = native_ring_reduce_scatter_np(stacked, block,
+                                                 resid=resid.copy())
+    # one f32 ulp at these magnitudes is ~1e-6; 7 hops of possible FMA
+    # contraction stay well inside 1e-5 while any REAL divergence (wrong
+    # chunk routing, a dropped hop, misaligned EF) is orders larger
+    assert np.abs(np.asarray(got).reshape(8, -1) - want).max() < 1e-5
+    assert np.abs(np.asarray(got_r).reshape(8, b) - want_r).max() < 1e-5
+
+    # exact case: block-constant values 127*k (k integer) quantize to
+    # +-127 with scale exactly |k| at EVERY hop — lossless end to end
+    k = rng.randint(-8, 9, (8, b // block)).astype(np.float32)
+    exact = np.repeat(k * 127.0, block, axis=1)
+    got_e, got_re = ring(exact, np.zeros_like(exact))
+    full = exact.sum(0)                  # any association exact: integers
+    csize = b // 8
+    rows = np.asarray(got_e).reshape(8, csize)
+    for p in range(8):
+        assert (rows[p] == full[p * csize:(p + 1) * csize]).all()
+    assert (np.asarray(got_re) == 0).all()
+
+    # DCN-group rings (the hierarchical leg): twin == device per group,
+    # same ulp-per-hop window
+    groups = [[0, 4], [1, 5], [2, 6], [3, 7]]   # ici=4, dcn=2 rings
+    want_g, _ = native_ring_reduce_scatter_np(stacked, block,
+                                              resid=resid.copy(),
+                                              groups=groups)
+
+    def ring_g_body(v, r):
+        perm = [(g[j], g[(j + 1) % 2]) for g in groups for j in range(2)]
+        from analytics_zoo_tpu.parallel import collective as Cx
+        pos = Cx.axis_index("dp") // 4
+        return plan._native_exchange(v[0], r[0], perm, 2, pos)
+
+    ring_g = jax.jit(shard_map(
+        ring_g_body, mesh=mesh, in_specs=(P("dp", None), P("dp", None)),
+        out_specs=(P("dp"), P("dp")), check_vma=False))
+    got_g, _ = ring_g(stacked, resid)
+    assert np.abs(np.asarray(got_g).reshape(8, -1) - want_g).max() < 1e-5
+
+
+@pytest.mark.parametrize("variant", ["classic", "hier"])
+def test_native_wire_error_feedback_bounds_drift(orca_context, variant):
+    """The PR-8 EF contract carries over to the native ring: 50 steps of
+    int8-on-the-wire training track the exact-f32 run within the same
+    drift bounds as the simulated wire, with the residual alive on the
+    same domain (flat classic / post-ICI chunk hierarchical)."""
+    data = _data(n=128)
+    steps = 50
+    epochs = -(-steps * 32 // 128)      # >= 50 optimizer steps
+    base = {"grad_bucket_mb": 0.001} if variant == "classic" \
+        else _hier_cfg()
+    le, _ = _fit(base, epochs=epochs, data=data)
+    lq, eq = _fit({**base, "allreduce_dtype": "int8",
+                   "allreduce_block": 64, "comms_native_int8": True},
+                  epochs=epochs, data=data)
+    assert eq.engine.comms_steps >= steps
+    lo = eq.engine.comms.layout
+    resid = np.asarray(eq.engine.comms_resid)
+    want_elems = (lo.padded_total // lo.ici if variant == "hier"
+                  else lo.padded_total)
+    assert resid.shape == (8, want_elems)
+    assert np.abs(resid).max() > 0
+    le, lq = np.asarray(le), np.asarray(lq)
+    assert np.all(np.abs(lq - le) <= 5e-3 * np.maximum(np.abs(le), 1e-3))
+    assert np.abs(lq[-1] - le[-1]) <= 2e-3 * max(abs(le[-1]), 1e-3)
+    snap = eq.data_pipeline_stats()["comms"]
+    assert snap["native_int8"] and snap["native_hops"] > 0
+    if variant == "classic":
+        # the packed ring moves ~(n-1)/n * (1 + 4/block) int8 bytes per
+        # f32 gradient element — better than 4x under the f32 wire
+        ratio = snap["grad_bytes_f32"] / snap["wire_bytes_per_step"]
+        assert ratio >= 3.0
+    else:
+        # the DCN leg genuinely shrinks vs the bf16 wire (the bench gate)
+        hier = snap["hierarchy"]
+        tree = jax.tree_util.tree_map(np.asarray, eq.engine.params)
+        lo_bf = build_layout(tree, 8, CommsConfig(
+            bucket_mb=0.001, wire_dtype="bf16", hierarchy=True,
+            dcn_size=2), ici=4, dcn=2)
+        assert (lo_bf.dcn_wire_bytes_per_step()
+                / hier["dcn_wire_bytes_per_step"]) >= 1.9
+
+
+def test_native_bit_identity_family(orca_context):
+    """The wire moved but the update math did not: sharded == unsharded,
+    overlapped and scan-fused dispatch all stay bit-identical on the
+    native ring, for the classic and the hierarchical variants."""
+    data = _data()
+    ln, en = _fit(_native_cfg(), data=data)
+    ls, es = _fit(_native_cfg(), data=data, sharded_update=True)
+    lo_, _ = _fit(_native_cfg(comms_overlap=True), data=data)
+    lf, _ = _fit(_native_cfg(), data=data, fuse=2, sharded_update=True)
+    assert ln == ls == lo_ == lf
+    assert (_flat_params(en) == _flat_params(es)).all()
+    lh, eh = _fit(_native_hier_cfg(), data=data)
+    lhs, ehs = _fit(_native_hier_cfg(), data=data, sharded_update=True)
+    assert lh == lhs
+    assert (_flat_params(eh) == _flat_params(ehs)).all()
+
+
+def test_native_clipping_matches_between_update_modes(orca_context):
+    """Norm clipping reads each replica's unique-ownership ring chunks,
+    so ZeRO-1 cannot move the clip threshold by an ulp under the native
+    wire either."""
+    def clipped(shard):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1,
+                                   **_native_cfg()},
+                           sharded_update=shard)
+        est.set_l2_norm_gradient_clipping(0.05)
+        stats = est.fit(dict(_data()), epochs=2, batch_size=32,
+                        verbose=False)
+        return [s["train_loss"] for s in stats], _flat_params(est)
+
+    lb, wb = clipped(False)
+    ls, ws = clipped(True)
+    assert lb == ls
+    assert (wb == ws).all()
+
+
+def test_native_salts_compile_key(orca_context):
+    """Native on/off is program shape — the simulated-wire executable
+    cannot be reused for the ring (and vice versa)."""
+    from analytics_zoo_tpu.orca.learn.utils import data_to_iterator
+
+    def key_for(cfg):
+        est = TPUEstimator(MLP(), loss="mse", optimizer="adam", seed=0,
+                           config={"steps_per_dispatch": 1, **cfg})
+        it = data_to_iterator(dict(_data()), 32, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        batch = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in batch.x))
+        return est.engine.train_step_cache_key(batch)
+
+    k_sim = key_for({"grad_bucket_mb": 0.001, "allreduce_dtype": "int8",
+                     "allreduce_block": 64})
+    k_nat = key_for(_native_cfg())
+    k_nat2 = key_for(_native_cfg())
+    k_nat_h = key_for(_native_hier_cfg())
+    assert None not in (k_sim, k_nat, k_nat_h)
+    assert k_nat == k_nat2               # same wire -> shared executable
+    assert len({k_sim, k_nat, k_nat_h}) == 3
+
+
+def test_native_accounting_byte_exact_and_tamper(orca_context):
+    """The acceptance flip: hlo_lint checks the native wire BYTE-EXACT —
+    no simulated-wire exemption — so tampering the declared hop count or
+    byte totals fails the gate on the real lowered program."""
+    from analytics_zoo_tpu.analysis.hlo_lint import HloLinter
+
+    est, text, declared = _build_lowered(_native_hier_cfg(),
+                                         sharded_update=True)
+    assert declared["native_int8"] and declared["native_hops"] > 0
+    assert not HloLinter().lint_text(text, label="train",
+                                     declared=declared)
+    bad_hops = dict(declared, native_hops=declared["native_hops"] + 1)
+    f1 = HloLinter().lint_text(text, label="train", declared=bad_hops)
+    assert f1 and any("ring hops" in f.message for f in f1)
+    bad_bytes = dict(declared, hierarchy=dict(
+        declared["hierarchy"],
+        dcn_wire_bytes_per_step=declared["hierarchy"]
+        ["dcn_wire_bytes_per_step"] + 4))
+    f2 = HloLinter().lint_text(text, label="train", declared=bad_bytes)
+    assert f2 and any("DCN leg moves" in f.message for f in f2)
+
+    # classic ring: the flat wire-byte claim is checked too (the
+    # simulated int8 wire skips this check; the native one must not)
+    est2, text2, declared2 = _build_lowered(_native_cfg())
+    assert not HloLinter().lint_text(text2, label="train",
+                                     declared=declared2)
+    bad3 = dict(declared2,
+                wire_bytes_per_step=declared2["wire_bytes_per_step"] + 4)
+    f3 = HloLinter().lint_text(text2, label="train", declared=bad3)
+    assert f3 and any("gradient wire moves" in f.message for f in f3)
