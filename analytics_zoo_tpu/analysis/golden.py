@@ -59,7 +59,7 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from .hlo_lint import (HloLinter, collective_counts, collectives_by_axis,
-                       parse_collectives)
+                       collectives_by_mesh_axes, parse_collectives)
 
 __all__ = ["capture_contracts", "capture_multihost_contract", "check",
            "check_multihost", "diff_contracts", "golden_path",
@@ -95,6 +95,17 @@ _LEGS = [
 ]
 
 
+# sharding-plane legs (PR 17): OWN mesh per leg (the comms legs run the
+# ctx's pure-dp mesh; fsdp/tp need the factored one) and the contract is
+# measured on COMPILED HLO — the sharding plane's collectives exist only
+# after the SPMD partitioner runs, so a lowering-only capture would pin
+# an empty program.
+_SHARDING_LEGS = [
+    ("sharding_fsdp", {"dp": 1, "fsdp": -1}),
+    ("sharding_fsdp_tp", {"dp": 1, "fsdp": -1, "tp": 2}),
+]
+
+
 def golden_path(root: Optional[str] = None) -> str:
     if root is None:
         root = os.path.join(os.path.dirname(os.path.dirname(
@@ -123,6 +134,26 @@ def _bench_model():
             return nn.Dense(1)(x)[:, 0]
 
     return BenchMLP()
+
+
+def _bench_tp_model():
+    import flax.linen as nn
+
+    from ..parallel.tensor_parallel import TPMLP
+
+    class BenchTPMLP(nn.Module):
+        """BenchMLP plus one Megatron column→row pair: the tp leg's
+        contract pins exactly ONE tp all-reduce per step-forward (the row
+        matmul's partial-product combine) riding next to the fsdp
+        gathers."""
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(32)(x))
+            x = TPMLP(64, out_dim=16, name="tp_mlp")(x)
+            return nn.Dense(1)(x)[:, 0]
+
+    return BenchTPMLP()
 
 
 def _bench_data():
@@ -225,6 +256,63 @@ def capture_contracts() -> Dict[str, Any]:
             entry["accounting_verified"] = not findings
             entry["accounting_findings"] = [str(f) for f in findings]
         contracts[name] = entry
+
+    # --- sharding-plane legs (fsdp / fsdp×tp on their own meshes) ----------
+    from ..parallel.mesh import create_mesh
+    from ..parallel.sharding import SpecLayout
+    from .hlo_lint import declared_comms
+
+    for name, axes in _SHARDING_LEGS:
+        mesh = create_mesh(axes)
+        model = _bench_tp_model() if "tp" in axes else _bench_model()
+        est = TPUEstimator(model, loss="mse", optimizer="adam", seed=0,
+                           mesh=mesh, compile_cache=cache,
+                           config={"steps_per_dispatch": 1},
+                           sharding=SpecLayout())
+        it = data_to_iterator(dict(data), 32, est.mesh, None, None,
+                              shuffle=False, config=est.config)
+        b0 = next(it.epoch(shuffle=False, prefetch=False))
+        est.engine.build(tuple(np.asarray(a) for a in b0.x))
+        fn = est.engine.ensure_jit_train()
+        args = est.engine.train_step_args(b0)
+        key = fn.cache_key(*args) if hasattr(fn, "cache_key") else None
+        if key:
+            train_keys.append(key)
+        # compiled HLO: the gathers/grad combines appear only post-partition
+        text = fn.lower(*args).compile().as_text()
+        ops = parse_collectives(text)
+        axis_sizes = {a: int(s) for a, s in mesh.shape.items() if s > 1}
+        ax = collectives_by_mesh_axes(ops, axis_sizes)
+        declared = declared_comms(est.engine._sharding_key())
+        plan = est.engine.fsdp_plan
+        entry = {
+            "mesh_axes": axis_sizes,
+            "collectives": collective_counts(ops),
+            "by_mesh_axes": {"by_axis": ax["by_axis"],
+                             "global": ax["global"]},
+            "fsdp_gather_bytes": int(
+                ax["axis_bytes"].get("fsdp", {}).get("all_gather", 0)),
+            "tp_collectives": dict(ax["by_axis"].get("tp", {})),
+            "buckets": (len(plan.layout.bucket_sizes)
+                        if plan is not None else 0),
+            "gather_shard_bytes_per_sweep": (
+                plan.gather_shard_bytes_per_sweep()
+                if plan is not None else 0),
+        }
+        if declared is not None:
+            findings = linter.lint_text(text, label=f"golden:{name}",
+                                        declared=declared)
+            entry["declared_tp"] = declared.get("tp")
+            entry["accounting_verified"] = not findings
+            entry["accounting_findings"] = [str(f) for f in findings]
+        contracts[name] = entry
+
+    # the tp leg's reason to exist, pinned: the row-parallel matmul really
+    # combines partials over the tp groups
+    if "sharding_fsdp_tp" in contracts:
+        tp_ops = contracts["sharding_fsdp_tp"]["tp_collectives"]
+        contracts["tp_all_reduce_present"] = (
+            tp_ops.get("all_reduce", 0) >= 1)
 
     # every leg must map to its own executable: a regression in the
     # comms fingerprint / extra_key salting collapses this number

@@ -62,7 +62,8 @@ from ..common import knobs
 logger = logging.getLogger("analytics_zoo_tpu")
 
 __all__ = ["CollectiveOp", "HloLintError", "HloLinter", "LintFinding",
-           "collective_counts", "collectives_by_axis", "declare_comms",
+           "collective_counts", "collectives_by_axis",
+           "collectives_by_mesh_axes", "declare_comms",
            "lint_report", "on_lowering", "parse_collectives"]
 
 # loss pmean + clip-norm psum (and at most a couple of bookkeeping
@@ -142,6 +143,10 @@ _GROUPS_DENSE_RE = re.compile(
     r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<(\d+)x(\d+)xi64>")
 # HLO text form: replica_groups={{0,1,2,3},{4,5,6,7}}
 _GROUPS_HLO_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+# HLO iota form: replica_groups=[2,4]<=[4,2]T(1,0) — G groups of S members
+# listed as a transposed iota (what the SPMD partitioner emits for an
+# all-gather over one named axis of a multi-axis mesh)
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
 # collective_permute carries source_target_pairs instead of replica_groups.
 # stablehlo/mhlo: source_target_pairs = dense<[[0,1],[1,0]]> : tensor<Nx2xi64>
 _PAIRS_DENSE_RE = re.compile(
@@ -196,6 +201,9 @@ def _permute_group_shape(line: str) -> Optional[Tuple[int, int]]:
 
 def _group_shape(line: str) -> Optional[Tuple[int, int]]:
     m = _GROUPS_DENSE_RE.search(line)
+    if m is not None:
+        return int(m.group(1)), int(m.group(2))
+    m = _GROUPS_IOTA_RE.search(line)
     if m is not None:
         return int(m.group(1)), int(m.group(2))
     m = _GROUPS_HLO_RE.search(line)
@@ -343,6 +351,46 @@ def collectives_by_axis(ops: Sequence[CollectiveOp], ici: int, dcn: int
                 "reduce_scatter", "all_reduce", "collective_permute",
                 "all_to_all"):
             out[f"{leg}_wire_bytes"] += op.operand_bytes
+    return out
+
+
+def collectives_by_mesh_axes(ops: Sequence[CollectiveOp],
+                             axis_sizes: Dict[str, int]) -> Dict[str, Any]:
+    """Classify collectives onto named mesh axes by replica-group shape:
+    a collective over axis ``a`` of size ``s`` on an ``n``-device mesh runs
+    ``n/s`` groups of ``s`` members. ``axis_sizes`` maps axis name -> size
+    (trivial axes may be included; they classify nothing). Ops matching no
+    axis — or carrying no groups — land in ``global``. Two nontrivial axes
+    of EQUAL size produce identical shapes; the result is then flagged
+    ``ambiguous`` (first listed axis wins the label) and callers must fall
+    back to combined totals. Shared by the sharding accounting rule, the
+    golden capture's fsdp/tp legs and ``bench --only sharding``."""
+    n = 1
+    for s in axis_sizes.values():
+        n *= int(s)
+    shapes: Dict[Tuple[int, int], str] = {}
+    ambiguous = False
+    for name, s in axis_sizes.items():
+        s = int(s)
+        if s <= 1:
+            continue
+        shape = (n // s, s)
+        if shape in shapes:
+            ambiguous = True
+            continue
+        shapes[shape] = name
+    out: Dict[str, Any] = {"by_axis": {name: {} for name in shapes.values()},
+                           "axis_bytes": {name: {} for name in shapes.values()},
+                           "global": {}, "ambiguous": ambiguous}
+    for op in ops:
+        name = shapes.get(op.group_shape) if op.group_shape else None
+        if name is None:
+            out["global"][op.kind] = out["global"].get(op.kind, 0) + 1
+            continue
+        out["by_axis"][name][op.kind] = (
+            out["by_axis"][name].get(op.kind, 0) + 1)
+        out["axis_bytes"][name][op.kind] = (
+            out["axis_bytes"][name].get(op.kind, 0) + op.operand_bytes)
     return out
 
 
@@ -521,6 +569,8 @@ class HloLinter:
 
     def _rule_accounting(self, text: str, label: str,
                          declared: Dict[str, Any]) -> List[LintFinding]:
+        if declared.get("plane") == "sharding":
+            return self._accounting_fsdp(text, label, declared)
         ops = parse_collectives(text)
         counts = collective_counts(ops)
         findings = []
@@ -594,6 +644,85 @@ class HloLinter:
                       f"margin")
         if not findings and self.record_verified:
             _record_verified(label, counts, declared)
+        return findings
+
+    def _accounting_fsdp(self, text: str, label: str,
+                         declared: Dict[str, Any]) -> List[LintFinding]:
+        """Per-mesh-axis accounting for the sharding plane (the engine
+        declares :meth:`FsdpPlan.summary` plus tp info): the fsdp leg's
+        all-gather launches must be whole sweeps of the declared buckets
+        moving exactly sweep × shard bytes, a train program must combine
+        grads over the fsdp groups, and a program with tp-sharded leaves
+        must actually launch tp collectives.
+
+        The sharding plane's collectives exist only AFTER the SPMD
+        partitioner runs — a pre-partition StableHLO module (what the
+        compile-plane hook lints) legitimately contains none, so an
+        op-free module passes; the compiled-HLO cross-check runs where
+        the compiled text is in hand (golden capture, bench)."""
+        ops = parse_collectives(text)
+        if not ops:
+            return []
+        fsdp = declared.get("fsdp") or {}
+        axes = dict(fsdp.get("axes") or {})
+        axis = fsdp.get("axis", "fsdp")
+        buckets = int(fsdp.get("buckets") or 0)
+        ax = collectives_by_mesh_axes(ops, axes)
+        findings: List[LintFinding] = []
+
+        def _fail(msg, **details):
+            findings.append(LintFinding(
+                rule="comms-accounting", severity="error", label=label,
+                message=msg,
+                details={"by_axis": ax["by_axis"], "global": ax["global"],
+                         "declared": declared, **details}))
+
+        if ax["ambiguous"]:
+            # two nontrivial axes of equal size: group shapes cannot tell
+            # the legs apart; only the combined gather-launch multiple
+            # stays checkable
+            total_ag = sum(leg.get("all_gather", 0)
+                           for leg in ax["by_axis"].values())
+            if buckets and (total_ag < buckets or total_ag % buckets):
+                _fail(f"program launches {total_ag} grouped all-gathers — "
+                      f"not a whole number of {buckets}-bucket sweeps "
+                      f"(equal-size axes: legs indistinguishable)")
+            if not findings and self.record_verified:
+                _record_verified(label, collective_counts(ops), declared)
+            return findings
+        leg = ax["by_axis"].get(axis, {})
+        if buckets:
+            ag = leg.get("all_gather", 0)
+            if ag < buckets or ag % buckets != 0:
+                _fail(f"fsdp leg launches {ag} all-gathers but accounting "
+                      f"declares {buckets} buckets per assembly sweep")
+            else:
+                sweeps = ag // buckets
+                measured = ax["axis_bytes"][axis].get("all_gather", 0)
+                want = sweeps * int(
+                    fsdp.get("gather_shard_bytes_per_sweep") or 0)
+                if measured != want:
+                    _fail(f"fsdp gathers move {measured} B/step in the "
+                          f"lowered program but accounting declares "
+                          f"{want} B/step ({sweeps} sweep(s) x "
+                          f"{fsdp.get('gather_shard_bytes_per_sweep')} B)",
+                          measured_gather_bytes=measured)
+            if label.startswith("train"):
+                combine = (leg.get("all_reduce", 0)
+                           + leg.get("reduce_scatter", 0))
+                if combine < 1:
+                    _fail("train program combines no gradients over the "
+                          "fsdp groups (no all-reduce/reduce-scatter on "
+                          "the fsdp leg)")
+        tp = declared.get("tp") or {}
+        if int(tp.get("axis_size") or 1) > 1 and int(
+                tp.get("sharded_leaves") or 0) > 0:
+            tleg = ax["by_axis"].get(tp.get("axis", "tp"), {})
+            if sum(tleg.values()) < 1:
+                _fail(f"{tp.get('sharded_leaves')} tp-sharded leaves "
+                      f"declared but the tp leg launches no collectives")
+        if not findings and self.record_verified:
+            _record_verified(label, collective_counts(ops), declared)
         return findings
 
     def _accounting_hier(self, ops: Sequence[CollectiveOp], label: str,

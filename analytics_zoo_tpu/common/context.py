@@ -135,6 +135,10 @@ def init_orca_context(cluster_mode: str = "local",
                 process_id = int(os.environ["ZOO_PROC_ID"])
 
         cfg = config or OrcaConfig()
+        if mesh_axes is None and os.environ.get("ZOO_MESH_AXES"):
+            # env default (registered knob); an explicit mesh_axes arg wins
+            from ..parallel.mesh import parse_mesh_axes
+            mesh_axes = parse_mesh_axes(os.environ["ZOO_MESH_AXES"])
         cfg = cfg.replace(cluster_mode=cluster_mode,
                           coordinator_address=coordinator_address,
                           mesh_axes=dict(mesh_axes or cfg.mesh_axes))

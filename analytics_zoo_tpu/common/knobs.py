@@ -105,6 +105,20 @@ _KNOBS = [
        "ZOO_ALLREDUCE_DTYPE=int8."),
     _k("ZOO_EMBED_GRAD_MODE", "str", "auto", "comms",
        "Embedding gradient exchange: auto | dense | sparse."),
+    # --- sharding plane -----------------------------------------------------
+    _k("ZOO_MESH_AXES", "str", None, "sharding",
+       "Default mesh factorization for init_orca_context when no mesh_axes "
+       "are passed, e.g. 'dp=1,fsdp=4,tp=2' (one axis may be -1 to absorb "
+       "the remaining devices)."),
+    _k("ZOO_SHARDING_PLANE", "bool", None, "sharding",
+       "Enter the sharding plane with the default SpecLayout: fsdp "
+       "param sharding (bucketed gathers) for unmatched big f32 leaves "
+       "plus the canonical tp/embedding rules."),
+    _k("ZOO_FSDP_BUCKET_MB", "float", None, "sharding",
+       "Target fsdp gather-bucket size; overrides SpecLayout.bucket_mb "
+       "(default 4.0). One all-gather per bucket fires inside the "
+       "forward, so fewer/larger buckets trade launch count for HBM "
+       "high-water."),
     # --- checkpoint plane ---------------------------------------------------
     _k("ZOO_CKPT_IO_RETRIES", "int", 2, "ckpt",
        "Retries for a failed checkpoint blob write before the writer "

@@ -3,8 +3,8 @@
 
 Each function runs a tiny CPU workload through the real production path of
 one plane and prints a single ``NAME=<json>`` line (``TRANSFER_PLANE=``,
-``CKPT_PLANE=``, ``COMMS_PLANE=``, ``RESILIENCE=``, ``ANALYSIS=``,
-``OBS=``). These used to live as five bespoke ``python - <<EOF`` heredocs
+``CKPT_PLANE=``, ``COMMS_PLANE=``, ``SHARDING_PLANE=``, ``RESILIENCE=``,
+``ANALYSIS=``, ``OBS=``). These used to live as five bespoke ``python - <<EOF`` heredocs
 inside run_tier1.sh; the script now loops over
 ``python -m analytics_zoo_tpu.obs snapshot <plane>`` so the
 snapshot logic is importable, testable and shared with the CLI.
@@ -210,6 +210,75 @@ def snapshot_comms() -> int:
             hh.get("dcn_wire_bytes_per_step", 0)
             / max(nh.get("dcn_wire_bytes_per_step", 1), 1), 2)}
     return _emit("COMMS_PLANE", out)
+
+
+def snapshot_sharding() -> int:
+    """The sharding plane (PR 17) on the 8-device simulated fsdp×tp mesh:
+    a small fit with the canonical SpecLayout — fsdp flat-vector buckets,
+    per-device param+optimizer bytes vs the full state, tp axis width —
+    plus a served predict from the canonical checkpoint params through a
+    sharded InferenceModel, checked bit-identical to the replicated
+    layout (SGD: fsdp gathers and output-dim splits preserve elementwise
+    order)."""
+    _ensure_sim_devices()
+    import flax.linen as nn
+    import jax
+    import numpy as np
+
+    from .. import init_orca_context
+    from ..orca.learn.estimator import TPUEstimator
+    from ..parallel.mesh import create_mesh
+    from ..parallel.sharding import SpecLayout
+    from ..pipeline.inference.inference_model import InferenceModel
+
+    init_orca_context("cpu-sim", mesh_axes={"dp": 1, "fsdp": 4, "tp": 2})
+    mesh = create_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(1)(x)[:, 0]
+
+    rng = np.random.RandomState(0)
+    data = {"x": rng.rand(256, 8).astype(np.float32),
+            "y": rng.rand(256).astype(np.float32)}
+
+    def run(sharding):
+        est = TPUEstimator(M(), loss="mse", optimizer="sgd", seed=0,
+                           mesh=mesh, config={"steps_per_dispatch": 1},
+                           sharding=sharding)
+        stats = est.fit(dict(data), epochs=1, batch_size=32, verbose=False)
+        return est, [s["train_loss"] for s in stats]
+
+    est, losses = run(SpecLayout())
+    est_r, losses_r = run(False)
+    snap = est.engine.sharding_snapshot()
+    full = sum(int(l.nbytes) for l in
+               jax.tree.leaves(est.engine.params)
+               + jax.tree.leaves(est.engine.opt_state))
+    params = est.engine.get_state()["params"]
+    params_r = est_r.engine.get_state()["params"]
+    xq = rng.rand(16, 8).astype(np.float32)
+    ps = InferenceModel(mesh=mesh, sharding=SpecLayout()).load_jax(
+        M(), {"params": params}).predict(xq)
+    pr = InferenceModel(mesh=mesh).load_jax(
+        M(), {"params": params_r}).predict(xq)
+    fsdp = snap.get("fsdp", {})
+    return _emit("SHARDING_PLANE", {
+        "axes": snap["axes"],
+        "tp_axis_size": snap["tp_axis_size"],
+        "buckets": fsdp.get("buckets"),
+        "ridden_leaves": fsdp.get("ridden_leaves"),
+        "held_leaves": fsdp.get("held_leaves"),
+        "gather_shard_bytes_per_sweep":
+            fsdp.get("gather_shard_bytes_per_sweep"),
+        "full_state_bytes": full,
+        "per_device_state_bytes": snap.get("per_device_state_bytes"),
+        "train_bit_identical": bool(losses == losses_r),
+        "serve_bit_identical": bool(
+            (np.asarray(ps) == np.asarray(pr)).all())})
 
 
 def snapshot_resilience() -> int:
@@ -504,7 +573,8 @@ def snapshot_streaming() -> int:
 
 
 PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,
-          "comms": snapshot_comms, "resilience": snapshot_resilience,
+          "comms": snapshot_comms, "sharding": snapshot_sharding,
+          "resilience": snapshot_resilience,
           "serving": snapshot_serving, "streaming": snapshot_streaming,
           "analysis": snapshot_analysis, "obs": snapshot_obs}
 

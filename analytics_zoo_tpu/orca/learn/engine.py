@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...obs import trace as _trace
 from ...parallel import comms as comms_lib
+from ...parallel.sharding import FsdpPlan, SpecLayout
 from ...resilience import faults as _faults
 from ...resilience import watchdog as _watchdog
 from .metrics import Metric
@@ -70,7 +71,8 @@ class TrainEngine:
                  loss_fn: Optional[Callable], metrics: Dict[str, Metric],
                  mesh: Mesh, seed: int = 0,
                  fsdp_params: bool = False, compile_cache=None,
-                 prologue=None, comms=None):
+                 prologue=None, comms=None,
+                 sharding: Optional[SpecLayout] = None):
         from ...compile import resolve_cache
         # every jitted step goes through the process-wide compile plane
         # (ExecutableCache): structurally identical engines share ONE XLA
@@ -105,6 +107,24 @@ class TrainEngine:
                 "comms plane (sharded_update/grad buckets/quantized wire) "
                 "and fsdp_params are mutually exclusive — the plane owns "
                 "the gradient collectives, fsdp hands them to GSPMD")
+        # sharding plane (parallel/sharding.py): SpecLayout-driven fsdp×tp
+        # over the multi-axis mesh — params live as a bucketed flat vector
+        # P("fsdp") plus tp-sharded held leaves, assembled (gathered) inside
+        # every jitted step. GSPMD owns all its collectives.
+        self.sharding = sharding if (sharding is not None
+                                     and getattr(sharding, "active", True)) \
+            else None
+        self.fsdp_plan: Optional[FsdpPlan] = None
+        if self.sharding is not None and self.comms_cfg is not None:
+            raise ValueError(
+                "sharding plane (SpecLayout fsdp×tp) and comms plane are "
+                "mutually exclusive — the comms plane's explicit shard_map "
+                "wire assumes replicated params on a pure-dp mesh; the "
+                "sharding plane hands every collective to GSPMD")
+        if self.sharding is not None and self.fsdp_params:
+            raise ValueError(
+                "sharding=SpecLayout supersedes fsdp_params (the legacy "
+                "per-leaf ZeRO split) — pass one or the other")
         self._train_kwarg = _module_train_kwarg(module)
         self.params = None
         self.extra_vars: Dict[str, Any] = {}
@@ -179,6 +199,8 @@ class TrainEngine:
         # with no "params" collection at all
         params = variables.pop("params", {})
         params, variables = self._capture_tp_specs(params, variables)
+        if self.sharding is not None:
+            params = self._build_sharding(params)
         self.params = jax.device_put(params, self._param_sharding(params))
         self.extra_vars = jax.device_put(
             variables, jax.tree.map(lambda _: self._repl, variables))
@@ -186,22 +208,56 @@ class TrainEngine:
             self._build_comms(self.params)
         if self.comms is not None and self.comms.cfg.sharded_update:
             self.opt_state = self._init_sharded_opt(self.params)
+        elif self.fsdp_plan is not None:
+            self.opt_state = self._init_sharded_tree_opt()
         else:
             opt_state = self.tx.init(self.params)
             self.opt_state = jax.device_put(opt_state,
                                             self._opt_sharding(opt_state))
         self.step = 0
 
+    # --- sharding plane (parallel/sharding.py) ------------------------------
+    def _build_sharding(self, params):
+        """Bind the SpecLayout to this param tree: merge module-declared tp
+        specs with the layout's rules, build the FsdpPlan over the leaves
+        left trivially-sharded, and convert params to the composite form
+        (bucketed flat vector P(fsdp) + held leaves). Returns the tree the
+        engine will own — composite when anything rides, else unchanged."""
+        self._tp_specs = self.sharding.merge_specs(params, self._tp_specs,
+                                                   self.mesh)
+        if self.sharding.fsdp:
+            self.fsdp_plan = FsdpPlan.build(
+                params, self._tp_specs, self.mesh,
+                axis=self.sharding.fsdp_axis,
+                bucket_mb=self.sharding.bucket_mb)
+        if self.fsdp_plan is None:
+            return params
+        return self.fsdp_plan.to_composite(jax.device_get(params))
+
+    def _init_sharded_tree_opt(self):
+        """Optimizer state over the composite params, jitted with sharded
+        out_shardings so no device ever materializes a full moment vector
+        (same rationale as :meth:`_init_sharded_opt` — the model may be
+        bigger than one chip)."""
+        template = jax.eval_shape(self.tx.init, self.params)
+        return jax.jit(self.tx.init,
+                       out_shardings=self._opt_sharding(template))(
+            self.params)
+
     # --- comms plane (parallel/comms.py) ------------------------------------
     def _build_comms(self, params):
         """Bind the comms config to this param tree's bucket layout. The
         plane owns the dp collectives, so the mesh must be pure-dp and the
         params replicated (no TP specs)."""
-        from ...parallel.mesh import pure_dp
-        if not pure_dp(self.mesh):
+        from ...parallel.mesh import nontrivial_axes
+        offending = [a for a in nontrivial_axes(self.mesh)
+                     if a != self.comms_cfg.axis]
+        if offending:
             raise ValueError(
-                "comms plane requires a pure data-parallel mesh (fsdp/tp/"
-                f"sp/pp/ep of size 1); got {dict(self.mesh.shape)}")
+                "comms plane requires a pure data-parallel mesh; axes "
+                f"{offending} have size > 1 (mesh {dict(self.mesh.shape)}) "
+                "— multi-axis meshes belong to the sharding plane "
+                "(sharding=SpecLayout), not the explicit dp wire")
         if self._tp_specs is not None:
             raise ValueError("comms plane does not support tensor-parallel "
                              "partitioned params")
@@ -319,6 +375,8 @@ class TrainEngine:
         return self._repl
 
     def _param_sharding(self, params):
+        if self.fsdp_plan is not None and FsdpPlan.is_composite(params):
+            return self.fsdp_plan.composite_shardings()
         if self._tp_specs is not None:
             try:
                 from jax.sharding import PartitionSpec
@@ -344,6 +402,14 @@ class TrainEngine:
         full param path (optax moments embed the entire params tree) adopts
         that param's sharding; counters/scalars fall through to the default
         rules."""
+        if self.fsdp_plan is not None:
+            # moment nodes over composite params ARE composites (optax
+            # inherits the structure); counters/scalars replicate
+            return jax.tree.map(
+                lambda node: (self.fsdp_plan.composite_shardings()
+                              if FsdpPlan.is_composite(node)
+                              else self._repl),
+                opt_state, is_leaf=FsdpPlan.is_composite)
         if self._tp_specs is None or self.params is None:
             return self._param_sharding_default(opt_state)
         shapes = {self._path_names(p): getattr(l, "shape", None)
@@ -378,6 +444,10 @@ class TrainEngine:
 
     # --- model application --------------------------------------------------
     def _apply(self, params, extra, x, train: bool, rng=None):
+        if self.fsdp_plan is not None and FsdpPlan.is_composite(params):
+            # the fsdp gathers: one all-gather per bucket, traced into this
+            # step; the assembled tree is a temporary of the forward
+            params = self.fsdp_plan.assemble(params)
         variables = {"params": params, **extra}
         kwargs = {}
         if self._train_kwarg == "deterministic":
@@ -426,8 +496,17 @@ class TrainEngine:
         (loss, (_, new_extra)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
         grads = self._clip_grads(grads)
+        if self.fsdp_plan is not None and FsdpPlan.is_composite(grads):
+            # constrain bucket grads back to P(fsdp): XLA combines over
+            # the fsdp groups and each device keeps only its own shard,
+            # so the optimizer update below is shard-local (ZeRO)
+            grads = self.fsdp_plan.constrain_shards(grads)
         updates, new_opt = self.tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        if self.fsdp_plan is not None and FsdpPlan.is_composite(new_params):
+            # pin updated params onto their resting shardings so scan
+            # carries and donated outputs keep the 1/N layout
+            new_params = self.fsdp_plan.constrain_shards(new_params)
         return new_params, new_extra, new_opt, loss
 
     def _train_multi_step(self, params, extra, opt_state, step0, xs, ys, ws):
@@ -758,7 +837,8 @@ class TrainEngine:
         if self._jit_eval_multi is None:
             self._jit_eval_multi = self._wrap("eval_multi",
                                               self._eval_multi_step,
-                                              donate_argnums=(2,))
+                                              donate_argnums=(2,),
+                                              extra_key=self._sharding_key())
         t0 = time.perf_counter()
         out = self._jit_eval_multi(self.params, self.extra_vars,
                                    metric_states, batch.x, batch.y,
@@ -795,6 +875,53 @@ class TrainEngine:
             key += ":" + self.comms.layout.signature()
         return key
 
+    def _sharding_key(self) -> Optional[str]:
+        """Sharding-plane fingerprint for the compile plane's structural
+        key: the SpecLayout rules + the fsdp bucket layout are part of
+        every step's identity (train AND eval/predict — the gathers are
+        traced into all of them), so two engines with different layouts
+        never share an executable. None when the plane is off, keeping
+        every pre-existing cache key byte-identical."""
+        if self.sharding is None:
+            return None
+        key = self.sharding.fingerprint()
+        if self.fsdp_plan is not None:
+            key += ":" + self.fsdp_plan.signature()
+        return key
+
+    def _declare_sharding_accounting(self):
+        """Register the fsdp plan's declared gather accounting under the
+        sharding key — the HLO linter cross-checks compiled programs
+        salted with it (per-axis launches/bytes == declared)."""
+        if self.fsdp_plan is None:
+            return
+        try:
+            from ...analysis.hlo_lint import declare_comms
+        except ImportError:
+            return
+        summary = self.fsdp_plan.summary()
+        tp_axis = self.sharding.tp_axis
+        tp_size = self.mesh.shape.get(tp_axis, 1)
+        tp_leaves = 0
+        if self._tp_specs is not None and tp_size > 1:
+            from ...parallel.sharding import _is_spec_leaf
+
+            def _mentions_tp(spec) -> bool:
+                if spec is None:
+                    return False
+                for entry in spec:
+                    axes = entry if isinstance(entry, tuple) else (entry,)
+                    if tp_axis in axes:
+                        return True
+                return False
+
+            tp_leaves = sum(
+                _mentions_tp(s) for s in jax.tree_util.tree_leaves(
+                    self._tp_specs, is_leaf=_is_spec_leaf))
+        summary["tp"] = {"axis": tp_axis, "axis_size": int(tp_size),
+                         "sharded_leaves": int(tp_leaves)}
+        declare_comms(self._sharding_key(), summary)
+
     def _comms_donate(self):
         # params + opt state always; the EF residual only when it exists
         # (donating an empty pytree arg is pointless noise)
@@ -824,8 +951,10 @@ class TrainEngine:
                     donate_argnums=self._comms_donate(),
                     extra_key=self._comms_key())
             else:
+                self._declare_sharding_accounting()
                 self._jit_train = self._wrap("train", self._train_step,
-                                             donate_argnums=(0, 2))
+                                             donate_argnums=(0, 2),
+                                             extra_key=self._sharding_key())
         return self._jit_train
 
     def train_step_args(self, batch: Batch) -> Tuple:
@@ -939,9 +1068,11 @@ class TrainEngine:
                     donate_argnums=self._comms_donate(),
                     extra_key=self._comms_key())
             else:
-                self._jit_train_multi = self._wrap("train_multi",
-                                                   self._train_multi_step,
-                                                   donate_argnums=(0, 2))
+                self._declare_sharding_accounting()
+                self._jit_train_multi = self._wrap(
+                    "train_multi", self._train_multi_step,
+                    donate_argnums=(0, 2),
+                    extra_key=self._sharding_key())
         wd = _watchdog.active()
         token = wd.enter("engine.dispatch") if wd is not None else None
         t0 = time.perf_counter()
@@ -984,7 +1115,8 @@ class TrainEngine:
             # metric states are consumed and replaced every batch — donate
             # them so XLA updates in place instead of reallocating
             self._jit_eval = self._wrap("eval", self._eval_step,
-                                        donate_argnums=(2,))
+                                        donate_argnums=(2,),
+                                        extra_key=self._sharding_key())
         return self._jit_eval
 
     def eval_batch(self, metric_states, batch: Batch):
@@ -1006,7 +1138,8 @@ class TrainEngine:
 
     def predict_batch(self, x) -> np.ndarray:
         if self._jit_predict is None:
-            self._jit_predict = self._wrap("predict", self._predict_step)
+            self._jit_predict = self._wrap("predict", self._predict_step,
+                                           extra_key=self._sharding_key())
         return self._jit_predict(self.params, self.extra_vars, x)
 
     # --- device-side state snapshot (probe/rollback support) ----------------
@@ -1051,6 +1184,51 @@ class TrainEngine:
                 "buckets": len(lo.bucket_sizes),
                 "layout_sig": lo.signature()}
 
+    # --- sharding telemetry -------------------------------------------------
+    def per_device_state_bytes(self) -> int:
+        """Param + optimizer bytes resident on ONE device (device 0's
+        shards; sharded leaves count 1/N, replicated leaves count full) —
+        the number the "4× one chip's HBM" acceptance bound checks."""
+        total = 0
+        for leaf in (jax.tree.leaves(self.params)
+                     + jax.tree.leaves(self.opt_state)):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += int(shards[0].data.nbytes)
+            elif hasattr(leaf, "nbytes"):
+                total += int(leaf.nbytes)
+        return total
+
+    def sharding_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Static sharding-plane accounting (mesh axes, fsdp buckets,
+        gather bytes, per-device state bytes); None when the plane is
+        off."""
+        if self.sharding is None:
+            return None
+        snap: Dict[str, Any] = {
+            "fingerprint": self._sharding_key(),
+            "axes": {name: int(size)
+                     for name, size in self.mesh.shape.items() if size > 1},
+            "tp_axis_size": self.mesh.shape.get(self.sharding.tp_axis, 1),
+        }
+        if self.fsdp_plan is not None:
+            snap["fsdp"] = self.fsdp_plan.summary()["fsdp"]
+        if self.params is not None and self.opt_state is not None:
+            snap["per_device_state_bytes"] = self.per_device_state_bytes()
+        return snap
+
+    def sharding_manifest_meta(self) -> Optional[Dict[str, Any]]:
+        """What a checkpoint manifest records about the sharding plane that
+        wrote it (state is stored in canonical tree form regardless)."""
+        if self.sharding is None:
+            return None
+        meta = {"fingerprint": self.sharding.fingerprint(),
+                "fsdp": self.fsdp_plan is not None}
+        if self.fsdp_plan is not None:
+            meta["buckets"] = len(self.fsdp_plan.layout.bucket_sizes)
+            meta["layout_sig"] = self.fsdp_plan.layout.signature()
+        return meta
+
     # --- state access -------------------------------------------------------
     def get_state(self) -> Dict[str, Any]:
         state = {"params": jax.device_get(self.params),
@@ -1068,6 +1246,14 @@ class TrainEngine:
             # Padding slots hold zeros, so the conversion is lossless.
             state["opt_state"] = self.comms.opt_flat_to_tree(
                 state["opt_state"])
+        if self.fsdp_plan is not None:
+            # same contract for the sharding plane: params and moments go
+            # out in canonical tree form, so fsdp-sharded ↔ replicated
+            # restores are bit-exact in both directions
+            state["params"] = self.fsdp_plan.composite_to_tree(
+                state["params"])
+            state["opt_state"] = self.fsdp_plan.state_to_tree(
+                state["opt_state"])
         if self.comms_resid is not None:
             state["comms_resid"] = jax.device_get(self.comms_resid)
             state["comms_layout_sig"] = self.comms.layout.signature()
@@ -1076,8 +1262,22 @@ class TrainEngine:
     def set_state(self, state: Dict[str, Any]):
         if state.get("tp_specs") is not None:
             self._tp_specs = state["tp_specs"]
-        self.params = jax.device_put(
-            state["params"], self._param_sharding(state["params"]))
+        params = state["params"]
+        if self.sharding is not None:
+            # restoring into a sharded engine (possibly never built —
+            # load before fit): bind the plan to the checkpoint's
+            # canonical tree and convert to the composite form
+            if self.fsdp_plan is None:
+                self._tp_specs = self.sharding.merge_specs(
+                    params, self._tp_specs, self.mesh)
+                if self.sharding.fsdp:
+                    self.fsdp_plan = FsdpPlan.build(
+                        params, self._tp_specs, self.mesh,
+                        axis=self.sharding.fsdp_axis,
+                        bucket_mb=self.sharding.bucket_mb)
+            if self.fsdp_plan is not None:
+                params = self.fsdp_plan.to_composite(params)
+        self.params = jax.device_put(params, self._param_sharding(params))
         self.extra_vars = jax.device_put(
             state["extra_vars"], jax.tree.map(lambda _: self._repl,
                                               state["extra_vars"]))
@@ -1103,6 +1303,13 @@ class TrainEngine:
                 opt_state = self.comms.opt_tree_to_flat(opt_state, template)
             self.opt_state = jax.device_put(
                 opt_state, self._comms_opt_sharding(opt_state))
+        elif self.fsdp_plan is not None:
+            # canonical tree-form moments -> composite. eval_shape only
+            # (structure template); nothing full-size materializes.
+            template = jax.eval_shape(self.tx.init, self.params)
+            opt_state = self.fsdp_plan.tree_to_state(opt_state, template)
+            self.opt_state = jax.device_put(
+                opt_state, self._opt_sharding(opt_state))
         else:
             self.opt_state = jax.device_put(
                 opt_state, self._opt_sharding(opt_state))

@@ -41,7 +41,7 @@ class Estimator:
                    loss=None, optimizer="adam", metrics=None,
                    model_dir: Optional[str] = None, backend: str = "tpu",
                    workers_per_node: int = 1, seed: int = 0,
-                   prologue=None):
+                   prologue=None, sharding=None):
         """Build an estimator from a flax module (or creator function), the
         TPU-native analogue of from_keras(model_creator) (reference:
         orca/learn/tf2/estimator.py:36-93). ``config`` is passed to the
@@ -52,7 +52,8 @@ class Estimator:
             module, loss, optimizer = module
         return TPUEstimator(module, loss=loss, optimizer=optimizer,
                             metrics=metrics, model_dir=model_dir,
-                            config=config, seed=seed, prologue=prologue)
+                            config=config, seed=seed, prologue=prologue,
+                            sharding=sharding)
 
     @staticmethod
     def from_jax(module=None, **kwargs):
@@ -74,7 +75,7 @@ class TPUEstimator:
                  model_dir: Optional[str] = None,
                  config: Optional[dict] = None, seed: int = 0, mesh=None,
                  fsdp: bool = False, compile_cache=None, prologue=None,
-                 sharded_update: Optional[bool] = None):
+                 sharded_update: Optional[bool] = None, sharding=None):
         self.ctx = get_context()
         self.mesh = mesh if mesh is not None else self.ctx.mesh
         self.module = module
@@ -101,10 +102,18 @@ class TPUEstimator:
         # GSPMD program, bit for bit.
         from ...parallel.comms import CommsConfig
         comms = CommsConfig.resolve(self.config, sharded_update)
+        # sharding plane (parallel/sharding.py): SpecLayout-driven fsdp×tp
+        # param sharding over the multi-axis mesh — models bigger than one
+        # chip. Knobs: ``sharding`` arg (SpecLayout | True | False) /
+        # config ``sharding`` / ZOO_SHARDING_PLANE, ZOO_FSDP_BUCKET_MB.
+        # All-default means OFF: the engine's step is byte-identical.
+        from ...parallel.sharding import SpecLayout
+        spec_layout = SpecLayout.resolve(self.config, sharding)
         self.engine = TrainEngine(module, tx, self.loss_fn, self.metrics,
                                   self.mesh, seed=seed, fsdp_params=fsdp,
                                   compile_cache=compile_cache,
-                                  prologue=prologue, comms=comms)
+                                  prologue=prologue, comms=comms,
+                                  sharding=spec_layout)
         # one stats object spans iterator assembly, the pump's H2D stage and
         # the engine's dispatches — the estimator is where they all meet
         from ...native.infeed import PipelineStats
@@ -180,6 +189,12 @@ class TPUEstimator:
             # counts + cumulative steps) — absent when the plane is off so
             # existing consumers see no new key
             snap["comms"] = comms
+        shard = self.engine.sharding_snapshot()
+        if shard is not None:
+            # sharding-plane accounting (mesh axes, fsdp buckets/gather
+            # bytes, per-device state bytes) — absent when the plane is
+            # off so existing consumers see no new key
+            snap["sharding"] = shard
         from ...resilience.stats import resilience_snapshot
         res = resilience_snapshot()
         if res:
@@ -874,6 +889,11 @@ class TPUEstimator:
             # opt state itself is stored in canonical tree form, so the
             # meta is provenance, not a format switch)
             meta = {**(meta or {}), "comms": comms_meta}
+        shard_meta = self.engine.sharding_manifest_meta()
+        if shard_meta is not None:
+            # same provenance record for the sharding plane — params and
+            # moments are stored in canonical tree form regardless
+            meta = {**(meta or {}), "sharding": shard_meta}
         path = plane.save(self.engine.get_state(), self.engine.step,
                           score=self._trainer_state.score,
                           meta=meta, blocking=blocking)

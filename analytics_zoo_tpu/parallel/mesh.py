@@ -18,7 +18,7 @@ jitted over the full mesh so tp/sp/fsdp can be enabled by config alone.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -105,12 +105,60 @@ def mesh_axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 
 
+def nontrivial_axes(mesh: Mesh, exclude: Tuple[str, ...] = ()
+                    ) -> Tuple[str, ...]:
+    """Mesh axes with size > 1, in mesh order — the axis-aware form the
+    pure-dp guards check against (an error can then NAME the offending
+    axes instead of just failing a boolean)."""
+    return tuple(name for name, size in mesh.shape.items()
+                 if size > 1 and name not in exclude)
+
+
 def pure_dp(mesh: Mesh, axis: str = "dp") -> bool:
     """True when ``axis`` is the only non-trivial mesh axis — the regime
     the comms plane (parallel/comms.py) owns: params replicated, batch
-    split over ``axis``, every collective explicit."""
-    return all(size == 1 for name, size in mesh.shape.items()
-               if name != axis)
+    split over ``axis``, every collective explicit. Multi-axis (fsdp/tp)
+    meshes belong to the sharding plane (parallel/sharding.py)."""
+    return not nontrivial_axes(mesh, exclude=(axis,))
+
+
+def parse_mesh_axes(spec: str) -> Dict[str, int]:
+    """Parse a ``ZOO_MESH_AXES`` string — ``"dp=2,fsdp=2,tp=2"`` (one axis
+    may be ``-1`` to absorb the remaining devices) — into the axes dict
+    ``create_mesh``/``init_orca_context`` take. Validates axis names
+    against the canonical + optional sets so a typo fails here, not as an
+    opaque reshape error later."""
+    axes: Dict[str, int] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"ZOO_MESH_AXES entry {part!r} is not name=size "
+                "(expected e.g. 'dp=2,fsdp=2,tp=2')")
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in AXIS_ORDER + OPTIONAL_AXES:
+            raise ValueError(
+                f"ZOO_MESH_AXES axis {name!r} unknown — known: "
+                f"{AXIS_ORDER + OPTIONAL_AXES}")
+        axes[name] = int(size)
+    if not axes:
+        raise ValueError(f"ZOO_MESH_AXES {spec!r} names no axes")
+    return axes
+
+
+def mesh_topology(mesh: Mesh) -> Dict[str, Any]:
+    """Factor the mesh into its named axes plus the two-level (dcn, ici)
+    split of the data axis — the one dict snapshots/benches record about
+    device topology (extends ``dp_topology``, which factors only the dp
+    axis, to the multi-axis meshes the sharding plane runs on)."""
+    dcn, ici = dp_topology(mesh)
+    return {"axes": {name: int(size) for name, size in mesh.shape.items()},
+            "nontrivial": list(nontrivial_axes(mesh)),
+            "n_devices": int(np.prod(list(mesh.shape.values()))),
+            "dp_dcn": dcn, "dp_ici": ici}
 
 
 def batch_divisor(mesh: Mesh) -> int:

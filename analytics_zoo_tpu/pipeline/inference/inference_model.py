@@ -49,17 +49,30 @@ class InferenceModel:
     per SURVEY §2.3 ("per-core compiled executables; batch dim sharding").
     Shape buckets are rounded up to a multiple of the device count so the
     sharded leading dim always divides evenly.
+
+    Sharding plane (PR 17): pass ``sharding=`` a
+    :class:`~analytics_zoo_tpu.parallel.sharding.SpecLayout` (or ``True``
+    for the default layout) on an fsdp/tp-factored mesh and the weights are
+    *partitioned* across devices instead of replicated —
+    ``SpecLayout.param_shardings`` places rule-matched leaves (embedding
+    tables over fsdp×tp) on their declared axes and splits every other big
+    leaf over the fsdp axis, so a model ~N× one chip's HBM serves on an
+    N-way mesh. The batch dim then shards over the (dp, fsdp) axes only —
+    tp ranks see the full batch, as the tp layers' row/column matmuls
+    require — and buckets round to that divisor rather than the full
+    device count.
     """
 
     DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
     def __init__(self, supported_concurrent_num: int = 1,
                  batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 mesh=None, compile_cache=None):
+                 mesh=None, compile_cache=None, sharding=None):
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         from ...compile import resolve_cache
+        from ...parallel.sharding import SpecLayout
         # concurrency arg kept for API parity; XLA executables are reentrant
         self.concurrency = supported_concurrent_num
         # serving compiles through the process-wide compile plane: bucket
@@ -75,10 +88,20 @@ class InferenceModel:
         self._ndev = int(np.prod(list(mesh.shape.values())))
         self._axes = tuple(mesh.axis_names)
         self._repl = NamedSharding(mesh, P())
-        self._data_spec = P(self._axes)     # batch dim over every mesh axis
-        # buckets rounded so the sharded batch dim always divides the mesh
+        self.sharding = SpecLayout.resolve(None, sharding)
+        if self.sharding is not None:
+            # batch over (dp, fsdp) only; tp ranks consume the full batch
+            batch_axes = self.sharding.batch_axes(mesh)
+            self._data_spec = P(batch_axes)
+            self._batch_div = int(np.prod(
+                [mesh.shape.get(a, 1) for a in batch_axes]))
+        else:
+            self._data_spec = P(self._axes)  # batch dim over every mesh axis
+            self._batch_div = self._ndev
+        # buckets rounded so the sharded batch dim always divides its axes
         self.buckets = tuple(sorted(
-            {math.ceil(b / self._ndev) * self._ndev for b in batch_buckets}))
+            {math.ceil(b / self._batch_div) * self._batch_div
+             for b in batch_buckets}))
         self._apply_fn: Optional[Callable] = None
         self._variables = None
         # on-device input prologue (orca/learn/prologue.BatchPrologue):
@@ -118,6 +141,18 @@ class InferenceModel:
         they are keyed by program, so they can never be served wrongly)."""
         self._cache.clear()
         self._jit_apply = None
+
+    def _place_variables(self, variables):
+        """Put a variable tree on the mesh: partitioned per the SpecLayout
+        when the sharding plane is on (per-device weight bytes ~1/fsdp of
+        the full model), replicated otherwise. Every loader/swap path goes
+        through here so hot-reload and quantize keep the layout."""
+        import jax
+        if self.sharding is not None:
+            return jax.device_put(
+                variables,
+                self.sharding.param_shardings(self.mesh, variables))
+        return jax.device_put(variables, self._repl)
 
     def _shard_batch(self, arr):
         """Place one padded input on the mesh, batch dim sharded: each chip
@@ -161,7 +196,7 @@ class InferenceModel:
             return out
 
         self._apply_fn = apply_fn
-        self._variables = jax.device_put(variables, self._repl)
+        self._variables = self._place_variables(variables)
         self._eager = False
         self._reset_executables()
         return self
@@ -222,7 +257,7 @@ class InferenceModel:
             return orig_apply(jax.tree_util.tree_unflatten(treedef, deq), *x)
 
         self._apply_fn = apply_fn
-        self._variables = jax.device_put(q_vars, self._repl)
+        self._variables = self._place_variables(q_vars)
         self._reset_executables()
         logger.info("quantized %d weight tensors to int8", n_quantized)
         return self
@@ -326,8 +361,7 @@ class InferenceModel:
                 raise ValueError(
                     f"{root}: estimator checkpoint has no module; load a "
                     "model first (load_jax) for weights-only adoption")
-            import jax
-            self._variables = jax.device_put(variables, self._repl)
+            self._variables = self._place_variables(variables)
             self._reset_executables()
             return self
         return self.load_jax(module, variables)
@@ -389,7 +423,7 @@ class InferenceModel:
         if same:
             # weights-only swap: executables are keyed on program + input
             # shapes, both unchanged — no reset, no recompile
-            self._variables = jax.device_put(variables, self._repl)
+            self._variables = self._place_variables(variables)
             self._ckpt_counters["hot_reloads"] += 1
             self._ckpt_counters["last_reload_step"] = int(step)
             self._loaded_step = int(step)
@@ -572,7 +606,7 @@ class InferenceModel:
             module, loader = self._pending_torch
             variables = module.init(jax.random.PRNGKey(0),
                                     *[a[:1] for a in xs])
-            self._variables = jax.device_put(loader(variables), self._repl)
+            self._variables = self._place_variables(loader(variables))
         n = len(xs[0])
         if self._eager:
             # no compilation to amortize — padding would just run the TF

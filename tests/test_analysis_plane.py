@@ -339,8 +339,13 @@ def test_committed_goldens_carry_verified_accounting():
         entry = contracts[name]
         assert entry["accounting_verified"] is True, (name, entry)
         assert entry["declared"]["wire_bytes_per_step"] > 0
+    # the sharding-plane legs (PR 17) verify per-mesh-axis accounting at
+    # capture time too
+    for name, _ in golden_mod._SHARDING_LEGS:
+        assert contracts[name]["accounting_verified"] is True, name
     # every leg lowers to its own executable (extra_key salting intact)
-    assert contracts["distinct_train_executables"] == len(golden_mod._LEGS)
+    assert contracts["distinct_train_executables"] == \
+        len(golden_mod._LEGS) + len(golden_mod._SHARDING_LEGS)
 
 
 def test_golden_gate_fails_on_injected_collective_regression():

@@ -320,6 +320,42 @@ def test_hot_reload_from_estimator_checkpoint(orca_context, tmp_path):
     model.disable_hot_reload()
 
 
+def test_hot_reload_from_fsdp_sharded_training(orca_context, tmp_path):
+    """PR 17: a training run sharded over an fsdp×tp mesh checkpoints in
+    canonical tree form, so a plain replicated serving process hot-swaps
+    its weights without ever knowing the sharding plane exists."""
+    import jax
+    import flax.linen as nn
+    from analytics_zoo_tpu.orca.learn.estimator import TPUEstimator
+    from analytics_zoo_tpu.parallel.mesh import create_mesh
+    from analytics_zoo_tpu.parallel.sharding import SpecLayout
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    class Wide(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(nn.relu(nn.Dense(64)(x)))[:, 0]
+
+    x, y = _linear_data()
+    mesh = create_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+    est = TPUEstimator(Wide(), loss="mse", optimizer="sgd", mesh=mesh,
+                       sharding=SpecLayout(), model_dir=str(tmp_path))
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=32, verbose=False)
+    assert est.engine.fsdp_plan is not None
+    est.save_checkpoint(str(tmp_path), blocking=True)
+
+    model = InferenceModel()
+    module = Wide()
+    model.load_jax(module, module.init(jax.random.PRNGKey(1),
+                                       np.zeros((1, 4), np.float32)))
+    w = model.enable_hot_reload(str(tmp_path), poll_s=60)
+    assert w.poll_now()
+    got = model.predict(x[:8])
+    want = est.predict({"x": x[:8]}, batch_size=8)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    model.disable_hot_reload()
+
+
 # --- trial runtime ----------------------------------------------------------
 def test_trial_runtime_checkpoints_through_plane(orca_context, tmp_path):
     """TrialRuntime durable trial states ride the plane: committed dirs,

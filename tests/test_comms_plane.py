@@ -483,6 +483,20 @@ def test_comms_requires_pure_dp_mesh(orca_context):
         est.fit(dict(_data()), epochs=1, batch_size=32, verbose=False)
 
 
+def test_comms_and_sharding_planes_are_exclusive(orca_context):
+    """PR 17: the explicit dp wire and the SpecLayout plane own different
+    collectives — combining them on a multi-axis mesh is a config error
+    whose message names the plane that does support such meshes."""
+    from analytics_zoo_tpu.parallel.mesh import create_mesh
+    from analytics_zoo_tpu.parallel.sharding import SpecLayout
+    mesh = create_mesh({"dp": 1, "fsdp": 4, "tp": 2})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        TPUEstimator(MLP(), loss="mse", optimizer="sgd", mesh=mesh,
+                     sharding=SpecLayout(),
+                     config={"steps_per_dispatch": 1,
+                             "grad_bucket_mb": 1.0})
+
+
 def test_comms_config_resolve_env(orca_context, monkeypatch):
     assert not CommsConfig.resolve({}).active
     monkeypatch.setenv("ZOO_SHARDED_UPDATE", "1")
