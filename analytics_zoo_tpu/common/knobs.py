@@ -154,6 +154,39 @@ _KNOBS = [
     _k("ZOO_SERVING_SLACK_MS", "float", 5.0, "serving",
        "Dispatch-now threshold: a formed batch is dispatched immediately "
        "once its head request's deadline slack drops to this."),
+    # --- serving fleet (scale-out tier) -------------------------------------
+    _k("ZOO_FLEET_WORKERS", "int", 1, "fleet",
+       "Initial worker-process count a ServingFleet spawns (the floor the "
+       "autoscaler never drops below)."),
+    _k("ZOO_FLEET_MAX_WORKERS", "int", 4, "fleet",
+       "Ceiling on worker processes — shared-nothing fan-out stops here "
+       "even under sustained saturation (one worker per chip set)."),
+    _k("ZOO_FLEET_SCALE_OCCUPANCY", "float", 0.75, "fleet",
+       "Scale-up threshold on mean worker occupancy (busy-seconds rate); "
+       "sustained occupancy at or above it adds a worker."),
+    _k("ZOO_FLEET_IDLE_OCCUPANCY", "float", 0.15, "fleet",
+       "Scale-down threshold: mean occupancy at or below it with an empty "
+       "backlog, sustained, retires a worker."),
+    _k("ZOO_FLEET_SCALE_UP_SUSTAIN_S", "float", 1.0, "fleet",
+       "How long saturation must persist before a scale-up (rejects "
+       "one-tick spikes)."),
+    _k("ZOO_FLEET_SCALE_DOWN_SUSTAIN_S", "float", 5.0, "fleet",
+       "How long idleness must persist before a scale-down (longer than "
+       "the up-sustain: capacity is cheap to keep, misses are not)."),
+    _k("ZOO_FLEET_SCALE_COOLDOWN_S", "float", 5.0, "fleet",
+       "Dead time after any scale action during which the autoscaler "
+       "holds — the hysteresis that stops worker-count flapping."),
+    _k("ZOO_FLEET_QUEUE_AGE_SHED_MS", "float", 0.0, "fleet",
+       "Frontend queue-age shed: when the broker's head-of-line entry is "
+       "older than this, /predict replies 429 + Retry-After BEFORE "
+       "enqueueing. 0 disables."),
+    _k("ZOO_FLEET_HEARTBEAT_S", "float", 0.5, "fleet",
+       "Worker heartbeat period through the broker (liveness + occupancy "
+       "stats for the autoscaler and /readyz)."),
+    _k("ZOO_FLEET_WORKER_TTL_S", "float", 3.0, "fleet",
+       "A worker whose last heartbeat is older than this is presumed "
+       "dead: dropped from live_workers, its pending claims left to "
+       "idle-reclaim."),
     # --- streaming plane ----------------------------------------------------
     _k("ZOO_STREAM_WINDOW_RECORDS", "int", 1024, "streaming",
        "Records per training window (rounded up to a whole number of "

@@ -405,6 +405,51 @@ def snapshot_serving() -> int:
         "metric_families": serving_families})
 
 
+def snapshot_fleet() -> int:
+    """The scale-out serving tier end to end: a two-worker ServingFleet
+    (separate processes, shared-nothing) fanning over one FileBroker
+    spool as a consumer group — live workers seen through broker
+    heartbeats, records served across the fleet, and the idle-reclaim
+    counter (zero here: nobody dies in the snapshot; the chaos leg lives
+    in bench.py / tests)."""
+    import functools
+
+    import numpy as np
+
+    from ..serving.codecs import decode_payload, encode_payload
+    from ..serving.fleet import ServingFleet, sleep_model_factory
+    from ..serving.queue_api import make_broker
+
+    with tempfile.TemporaryDirectory() as d:
+        spec = f"file://{d}/fleet?claim_idle_s=2.0"
+        fleet = ServingFleet(
+            functools.partial(sleep_model_factory, 2.0, 5.0), spec,
+            workers=2, autoscale=False, batch_size=4, max_inflight=8,
+            heartbeat_s=0.2, worker_ttl_s=2.0, drain_s=10.0).start()
+        broker = make_broker(spec)
+        ok = 0
+        try:
+            live_ok = fleet.wait_live(2, 30.0)
+            n = 48
+            for i in range(n):
+                broker.enqueue(f"s{i}", encode_payload(
+                    np.ones(4, np.float32)))
+            for i in range(n):
+                raw = broker.get_result(f"s{i}", 20.0)
+                if raw is not None:
+                    out, meta = decode_payload(raw)
+                    ok += not meta.get("error")
+        finally:
+            snap = fleet.stop()
+    return _emit("FLEET", {
+        "workers": snap["workers_target"],
+        "workers_live_ok": bool(live_ok),
+        "requests": n, "results_ok": ok,
+        "records_out_total": snap["records_out_total"],
+        "reclaimed_total": snap["reclaimed_total"],
+        "restarts": snap["restarts"]})
+
+
 def snapshot_analysis() -> int:
     """Repo lint findings, golden program-contract drift, and the HLO
     linter's hook report from a bucketed comms fit on the simulated
@@ -575,7 +620,8 @@ def snapshot_streaming() -> int:
 PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,
           "comms": snapshot_comms, "sharding": snapshot_sharding,
           "resilience": snapshot_resilience,
-          "serving": snapshot_serving, "streaming": snapshot_streaming,
+          "serving": snapshot_serving, "fleet": snapshot_fleet,
+          "streaming": snapshot_streaming,
           "analysis": snapshot_analysis, "obs": snapshot_obs}
 
 

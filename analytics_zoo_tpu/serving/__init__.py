@@ -1,6 +1,7 @@
 from .client import InputQueue, OutputQueue
 from .codecs import SparseTensor
 from .engine import ClusterServing, Timer
+from .fleet import Autoscaler, ServingFleet, SleepModel, sleep_model_factory
 from .queue_api import FileBroker, InMemoryBroker, RedisBroker, make_broker
 from .redis_protocol import MiniRedisServer, RedisClient
 from .scheduler import ContinuousScheduler, ModelMultiplexer
@@ -8,4 +9,6 @@ from .scheduler import ContinuousScheduler, ModelMultiplexer
 __all__ = ["InputQueue", "OutputQueue", "ClusterServing", "Timer",
            "InMemoryBroker", "FileBroker", "RedisBroker", "MiniRedisServer",
            "RedisClient", "make_broker", "SparseTensor",
-           "ContinuousScheduler", "ModelMultiplexer"]
+           "ContinuousScheduler", "ModelMultiplexer",
+           "ServingFleet", "Autoscaler", "SleepModel",
+           "sleep_model_factory"]

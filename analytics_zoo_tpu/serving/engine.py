@@ -31,7 +31,6 @@ import numpy as np
 from ..common import knobs
 from ..obs import trace as _trace
 from ..obs.registry import REGISTRY, InstancedEvents
-from ..pipeline.inference.inference_model import InferenceModel
 from ..resilience import faults as _faults
 from ..resilience.stats import STATS
 from .codecs import decode_payload, densify, encode_payload
@@ -103,7 +102,9 @@ class ClusterServing:
                  policy: str = "continuous",
                  max_inflight: Optional[int] = None,
                  slack_ms: Optional[float] = None,
-                 form_ms: float = 2.0):
+                 form_ms: float = 2.0,
+                 worker_id: Optional[str] = None,
+                 heartbeat_s: Optional[float] = None):
         if isinstance(model, ModelMultiplexer):
             self.mux = model
         else:
@@ -134,6 +135,13 @@ class ClusterServing:
         # (ClusterServing.scala:60); XLA executables are reentrant so this is
         # the number of dispatch threads sharing the chip set.
         self.num_workers = model_parallelism
+        # fleet membership: with a worker_id, a heartbeat thread publishes
+        # liveness + occupancy stats through the broker every heartbeat_s
+        # (the autoscaler's signal, and /readyz's live-worker count)
+        self.worker_id = worker_id
+        self.heartbeat_s = float(knobs.get("ZOO_FLEET_HEARTBEAT_S")
+                                 if heartbeat_s is None else heartbeat_s)
+        self._hb_thread: Optional[threading.Thread] = None
         self.timer = Timer()
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -572,7 +580,43 @@ class ClusterServing:
                                  name=f"serving-worker-{i}")
             t.start()
             self._threads.append(t)
+        if self.worker_id:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="serving-heartbeat")
+            self._hb_thread.start()
         return self
+
+    # --- fleet heartbeat -----------------------------------------------------
+    def _hb_stats(self) -> Dict:
+        return {
+            "busy_s": round(float(self._c_busy.value), 6),
+            "records_out": self.records_out,
+            "inflight": self.sched.inflight,
+            "queue_depth": sum(self.sched.depths().values()),
+            "oldest_wait_s": round(self.sched.oldest_wait_s(), 4),
+            "reclaimed": int(getattr(self.broker, "reclaimed", 0)),
+            "draining": self.draining,
+        }
+
+    def _heartbeat_loop(self):
+        # first beat immediately: the fleet's wait_live() sees a spawned
+        # worker as soon as its engine starts, not one period later
+        while True:
+            try:
+                self.broker.heartbeat(self.worker_id, self._hb_stats())
+            except Exception as e:  # noqa: BLE001 — liveness is best-effort
+                logger.debug("heartbeat publish failed: %s", e)
+            if self._stop.wait(self.heartbeat_s):
+                return
+
+    def _clear_heartbeat(self):
+        if not self.worker_id:
+            return
+        try:
+            self.broker.clear_heartbeat(self.worker_id)
+        except Exception as e:  # noqa: BLE001 — broker may already be down
+            logger.debug("heartbeat clear failed: %s", e)
 
     def stop(self):
         self._stop.set()
@@ -581,6 +625,9 @@ class ClusterServing:
             self._pump_thread.join(timeout=5)
         for t in self._threads:
             t.join(timeout=5)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        self._clear_heartbeat()
         self._close_series()
 
     def drain(self, timeout_s: float = 30.0) -> Dict:
@@ -607,6 +654,9 @@ class ClusterServing:
             self._pump_thread.join(timeout=1)
         for t in self._threads:
             t.join(timeout=1)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        self._clear_heartbeat()
         # drop this instance's registry series like stop() does — a
         # supervisor that drain()s and rebuilds must not accumulate
         # dead-uuid series scrape after scrape; metrics() keeps working

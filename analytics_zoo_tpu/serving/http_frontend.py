@@ -25,6 +25,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..common import knobs
 from ..obs import trace as _trace
 from ..obs.export import prometheus_text
 from ..obs.registry import REGISTRY, InstancedEvents
@@ -44,7 +45,9 @@ def _parse_tensor_value(v):
 
 def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
                serving=None, auth_token: Optional[str] = None,
-               max_pending: Optional[int] = None):
+               max_pending: Optional[int] = None,
+               worker_ttl_s: Optional[float] = None,
+               queue_age_shed_ms: Optional[float] = None):
     """``serving``: optional ClusterServing engine to expose under
     GET /metrics (the reference surfaces Flink numRecordsOutPerSecond +
     stage timers the same way, ClusterServingGuide:525). ``auth_token``:
@@ -60,6 +63,17 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
     process liveness, ``GET /readyz`` flips 503 while draining or while
     the serving circuit breaker is open.
 
+    Fleet mode (scale-out tier): with ``worker_ttl_s`` set and no
+    embedded engine, this frontend is one of N doors to a worker fleet —
+    ``/readyz`` 503s when the broker is unreachable or ZERO workers have
+    a fresh heartbeat (an orchestrator must not route traffic into a
+    stream nobody consumes), and ``metrics()`` / ``/metrics.prom``
+    surface the live-worker count. ``queue_age_shed_ms`` (default: the
+    ``ZOO_FLEET_QUEUE_AGE_SHED_MS`` knob; 0 disables) sheds BEFORE
+    enqueue when the broker's head-of-line entry is older than the
+    bound: head age lower-bounds what a new arrival will wait, so a 429
+    + ``Retry-After`` now beats an answer that expires later.
+
     Observability (obs plane): ``GET /metrics.prom`` serves the unified
     registry as Prometheus text exposition next to the byte-compatible
     JSON body; with tracing armed (``ZOO_TRACE=1``) each predict opens a
@@ -68,6 +82,9 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
     from aiohttp import web
 
     broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
+    shed_age_s = float(knobs.get("ZOO_FLEET_QUEUE_AGE_SHED_MS")
+                       if queue_age_shed_ms is None
+                       else queue_age_shed_ms) / 1e3
     # admission counters live in the unified metrics registry (obs plane),
     # labeled per app instance so this app's JSON /metrics body still
     # starts at 0 (byte-compatible with the pre-registry per-app dict)
@@ -75,16 +92,30 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
     events = InstancedEvents(
         REGISTRY.counter(
             "zoo_serving_http_events_total",
-            "HTTP-frontend admission events: 429 rejections, expired "
-            "results observed at fetch", labelnames=("inst", "event")),
-        ("rejected_429", "expired_results"))
+            "HTTP-frontend admission events: 429 rejections (backlog "
+            "bound and queue-age shed), expired results observed at "
+            "fetch", labelnames=("inst", "event")),
+        ("rejected_429", "expired_results", "shed_queue_age"))
     counters = events.children
+    g_workers = REGISTRY.gauge(
+        "zoo_serving_frontend_workers_live",
+        "fleet workers with a fresh broker heartbeat, as seen from this "
+        "frontend's readiness/metrics probes",
+        labelnames=("inst",)).labels(inst=events.inst)
+
+    def _live_worker_count() -> int:
+        # executor-side probe (broker round trip / dir scan)
+        n = len(broker.live_workers(worker_ttl_s))
+        g_workers.set(n)
+        return n
 
     async def _drop_counter_series(app):
         # app teardown drops this instance's series from the exposition so
         # rebuilt apps never leak dead-uuid series (cached children keep
         # serving the JSON view if anything still holds the app)
         events.close()
+        REGISTRY.gauge("zoo_serving_frontend_workers_live",
+                       labelnames=("inst",)).remove(inst=events.inst)
 
     @web.middleware
     async def auth_middleware(request, handler):
@@ -120,7 +151,23 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
             if serving.breaker.snapshot()["state"] == "open":
                 return web.json_response(
                     {"status": "circuit_open"}, status=503)
-        return web.json_response({"status": "ready"})
+        # fleet health: ready means a predict can actually complete —
+        # the broker answers AND someone is consuming the stream
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, broker.pending)
+        except Exception as e:  # noqa: BLE001 — broker down = not ready
+            return web.json_response(
+                {"status": "broker_unreachable", "error": str(e)},
+                status=503)
+        body = {"status": "ready"}
+        if worker_ttl_s is not None and serving is None:
+            n = await loop.run_in_executor(None, _live_worker_count)
+            if n == 0:
+                return web.json_response(
+                    {"status": "no_workers"}, status=503)
+            body["workers_live"] = n
+        return web.json_response(body)
 
     async def metrics(request):
         # pending() can block (Redis XLEN round-trip, spool-dir listing) —
@@ -145,6 +192,16 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         if glob:
             res["process"] = glob
         body["resilience"] = res
+        if worker_ttl_s is not None:
+            # fleet view from this door: who is consuming the stream
+            try:
+                live = await loop.run_in_executor(
+                    None, broker.live_workers, worker_ttl_s)
+            except Exception as e:  # noqa: BLE001 — broker blip
+                live, body["fleet_error"] = {}, str(e)
+            g_workers.set(len(live))
+            body["fleet"] = {"workers_live": len(live),
+                             "workers": sorted(live)}
         return web.json_response(body)
 
     async def metrics_prom(request):
@@ -189,6 +246,20 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
                 {"error": f"unknown model {model_name!r}",
                  "models": sorted(serving.mux.names())}, status=404)
         loop = asyncio.get_running_loop()
+        if shed_age_s > 0:
+            # queue-age shed (fleet overload policy): when the stream's
+            # head entry has waited longer than the bound, a new arrival
+            # will wait at least that long — shed it BEFORE enqueue so
+            # the backlog drains instead of compounding. Cheaper than
+            # admitting work the engine will only deadline-shed later.
+            age_s = await loop.run_in_executor(None, broker.oldest_age_s)
+            if age_s > shed_age_s:
+                counters["shed_queue_age"].inc()
+                return web.json_response(
+                    {"error": "queue too old",
+                     "queue_age_ms": round(age_s * 1e3, 1),
+                     "shed_ms": round(shed_age_s * 1e3, 1)},
+                    status=429, headers={"Retry-After": "1"})
         if max_pending is not None:
             # bounded admission: reject BEFORE enqueuing anything, so an
             # overloaded broker never grows past the bound from this door.
@@ -315,6 +386,8 @@ def run_frontend(queue="memory://serving_stream", host: str = "0.0.0.0",
                  ssl_keyfile: Optional[str] = None,
                  max_pending: Optional[int] = None,
                  timeout_s: float = 30.0,
+                 worker_ttl_s: Optional[float] = None,
+                 queue_age_shed_ms: Optional[float] = None,
                  graceful_sigterm: bool = True):
     """Serve the app. With ``graceful_sigterm`` (default), SIGTERM drains
     the embedded serving engine before the server exits — the one signal
@@ -332,7 +405,9 @@ def run_frontend(queue="memory://serving_stream", host: str = "0.0.0.0",
     ssl_ctx = (make_ssl_context(ssl_certfile, ssl_keyfile)
                if ssl_certfile and ssl_keyfile else None)
     app = create_app(queue, timeout_s=timeout_s, serving=serving,
-                     auth_token=auth_token, max_pending=max_pending)
+                     auth_token=auth_token, max_pending=max_pending,
+                     worker_ttl_s=worker_ttl_s,
+                     queue_age_shed_ms=queue_age_shed_ms)
     if not graceful_sigterm:
         web.run_app(app, host=host, port=port, ssl_context=ssl_ctx)
         return
@@ -416,6 +491,15 @@ def main(argv=None):
                    help="per-request deadline: results are awaited this "
                         "long, and the engine sheds any request still "
                         "queued past it before device dispatch")
+    p.add_argument("--worker-ttl-s", type=float, default=None,
+                   help="fleet mode: /readyz 503s when no worker has a "
+                        "broker heartbeat fresher than this (pair with "
+                        "zoo-serving-fleet on the same --queue)")
+    p.add_argument("--queue-age-shed-ms", type=float, default=None,
+                   help="shed predicts with 429 before enqueue when the "
+                        "broker's head-of-line entry is older than this "
+                        "(default: the ZOO_FLEET_QUEUE_AGE_SHED_MS knob; "
+                        "0 disables)")
     p.add_argument("--auth-token", default=None,
                    help="require 'Authorization: Bearer <token>' on every "
                         "route but GET / (reference model-secure/secured "
@@ -464,7 +548,9 @@ def main(argv=None):
                      ssl_certfile=args.https_cert,
                      ssl_keyfile=args.https_key,
                      max_pending=args.max_pending,
-                     timeout_s=args.timeout_s)
+                     timeout_s=args.timeout_s,
+                     worker_ttl_s=args.worker_ttl_s,
+                     queue_age_shed_ms=args.queue_age_shed_ms)
     finally:
         if serving is not None:
             if serving.draining:

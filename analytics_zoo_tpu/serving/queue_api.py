@@ -17,17 +17,25 @@ two TPU-host-friendly backends:
 
 from __future__ import annotations
 
-import glob
+import itertools
 import json
+import logging
 import os
-import pickle
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+logger = logging.getLogger("analytics_zoo_tpu")
+
 
 class Broker:
+    #: entries this consumer stole back from a dead/stalled consumer's
+    #: pending set (the XAUTOCLAIM-parity counter the SIGKILL chaos gate
+    #: reads: reclaimed > 0 proves redelivery, lost == 0 proves nothing
+    #: fell through)
+    reclaimed: int = 0
+
     def enqueue(self, item_id: str, payload: bytes) -> None:
         raise NotImplementedError
 
@@ -50,10 +58,10 @@ class Broker:
         """Acknowledge a claimed entry WITHOUT publishing a result — the
         training-stream consumption path (streaming plane): records are
         acked only after the window that trained them is durably
-        committed. The in-memory/file brokers consume destructively at
-        claim time (at-most-once), so this is a no-op for them; the
-        Redis broker XACKs/XDELs the pending entry, completing the
-        at-least-once contract without a ``result:`` hash."""
+        committed. All three brokers now share the Redis discipline:
+        claimed entries stay pending until ``put_result``/``ack``, and a
+        consumer that dies mid-batch leaves them where a live consumer's
+        idle-reclaim (XAUTOCLAIM parity) re-delivers them."""
         return None
 
     def ack_many(self, item_ids) -> None:
@@ -63,8 +71,47 @@ class Broker:
         for item_id in item_ids:
             self.ack(item_id)
 
+    # --- fleet surface (scale-out serving tier) ----------------------------
+    def oldest_age_s(self) -> float:
+        """Age (seconds) of the oldest entry still on the stream —
+        claimed-but-unacked included — or 0.0 when empty. The frontends'
+        queue-age shed reads this: head-of-line age is a lower bound on
+        what a new arrival will wait, so shedding on it (429 +
+        Retry-After, before enqueue) beats admitting work that will only
+        expire."""
+        return 0.0
+
+    def heartbeat(self, worker_id: str,
+                  stats: Optional[Dict] = None) -> None:
+        """Publish worker liveness + occupancy stats through the broker
+        itself (no side channel): the fleet supervisor's autoscale signal
+        and the frontend ``/readyz`` live-worker count both read
+        :meth:`live_workers`. Default: no-op (exotic brokers stay
+        compatible)."""
+        return None
+
+    def clear_heartbeat(self, worker_id: str) -> None:
+        """Drop a worker's heartbeat (graceful drain/retire — the worker
+        disappears from ``live_workers`` immediately instead of aging out
+        over the TTL)."""
+        return None
+
+    def live_workers(self, ttl_s: float = 3.0) -> Dict[str, Dict]:
+        """``worker_id -> last heartbeat stats`` for workers whose
+        heartbeat is younger than ``ttl_s``."""
+        return {}
+
 
 class InMemoryBroker(Broker):
+    """Intra-process broker with Redis consumer-group parity: a claim
+    moves entries into a shared pending set (PEL) stamped with the
+    claiming consumer + claim time; ``put_result``/``ack`` releases them;
+    entries idle past ``claim_idle_s`` are stolen by whichever consumer
+    claims next (XAUTOCLAIM parity, counted in :attr:`reclaimed`).
+    :meth:`view` returns a handle over the SAME stream under a distinct
+    consumer id, so multi-consumer fleet semantics (disjoint claims,
+    dead-consumer reclaim) are testable without a Redis server."""
+
     _instances: Dict[str, "InMemoryBroker"] = {}
 
     @classmethod
@@ -73,32 +120,114 @@ class InMemoryBroker(Broker):
             cls._instances[name] = cls()
         return cls._instances[name]
 
-    def __init__(self):
-        self._q: List[Tuple[str, bytes]] = []
+    def __init__(self, claim_idle_s: float = 30.0,
+                 consumer: Optional[str] = None):
+        # stream rows: [seq, item_id, payload, t_enq]
+        self._q: List[List] = []
+        # PEL rows: seq -> [item_id, payload, t_enq, consumer, t_claim]
+        self._pel: Dict[int, List] = {}
+        self._by_item: Dict[str, List[int]] = {}
         self._results: Dict[str, bytes] = {}
+        self._hb: Dict[str, Tuple[float, Dict]] = {}
         self._cv = threading.Condition()
+        self._seq = itertools.count()
+        self.claim_idle_s = float(claim_idle_s)
+        self.consumer = consumer or f"mem-{uuid.uuid4().hex[:8]}"
+        self.reclaimed = 0
+
+    def view(self, consumer: Optional[str] = None,
+             claim_idle_s: Optional[float] = None) -> "InMemoryBroker":
+        """A second consumer over the SAME stream/results/PEL (the
+        in-memory analogue of two XREADGROUP connections in one group)."""
+        b = object.__new__(InMemoryBroker)
+        b._q = self._q
+        b._pel = self._pel
+        b._by_item = self._by_item
+        b._results = self._results
+        b._hb = self._hb
+        b._cv = self._cv
+        b._seq = self._seq
+        b.claim_idle_s = (self.claim_idle_s if claim_idle_s is None
+                          else float(claim_idle_s))
+        b.consumer = consumer or f"mem-{uuid.uuid4().hex[:8]}"
+        b.reclaimed = 0
+        return b
 
     def enqueue(self, item_id, payload):
         with self._cv:
-            self._q.append((item_id, payload))
+            self._q.append([next(self._seq), item_id, payload, time.time()])
             self._cv.notify_all()
+
+    def _steal_stale(self, max_items: int) -> List[Tuple[str, bytes]]:
+        # caller holds self._cv; XAUTOCLAIM parity: re-deliver entries
+        # whose claim went idle (their consumer died mid-batch, or wedged)
+        now = time.time()
+        out = []
+        for seq in sorted(self._pel):
+            if len(out) >= max_items:
+                break
+            row = self._pel[seq]
+            if now - row[4] >= self.claim_idle_s:
+                row[3] = self.consumer
+                row[4] = now
+                out.append((row[0], row[1]))
+        return out
 
     def claim_batch(self, max_items, timeout_s):
         deadline = time.time() + timeout_s
+        # bounded waits, not one long one: a PEL entry becoming stale
+        # fires no notify, so the reclaim scan must get its turn
+        poll = max(min(self.claim_idle_s / 4.0, 0.05), 0.002)
         with self._cv:
-            while not self._q:
+            while True:
+                batch = self._steal_stale(max_items)
+                self.reclaimed += len(batch)
+                take = self._q[:max_items - len(batch)]
+                del self._q[:len(take)]
+                now = time.time()
+                for seq, item_id, payload, t_enq in take:
+                    self._pel[seq] = [item_id, payload, t_enq,
+                                      self.consumer, now]
+                    self._by_item.setdefault(item_id, []).append(seq)
+                    batch.append((item_id, payload))
+                if batch:
+                    return batch
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     return []
-                self._cv.wait(remaining)
-            batch = self._q[:max_items]
-            del self._q[:len(batch)]
-            return batch
+                self._cv.wait(min(remaining, poll))
+
+    def _release(self, item_id: str, all_entries: bool):
+        # caller holds self._cv
+        seqs = self._by_item.get(item_id)
+        if not seqs:
+            return
+        take = seqs if all_entries else seqs[:1]
+        for seq in take:
+            self._pel.pop(seq, None)
+        left = seqs[len(take):]
+        if left:
+            self._by_item[item_id] = left
+        else:
+            self._by_item.pop(item_id, None)
 
     def put_result(self, item_id, payload):
         with self._cv:
+            # one entry per result, like the Redis broker: a duplicate
+            # enqueue of the same uri keeps its own pending entry until
+            # its own result publishes
+            self._release(item_id, all_entries=False)
             self._results[item_id] = payload
             self._cv.notify_all()
+
+    def ack(self, item_id):
+        with self._cv:
+            self._release(item_id, all_entries=True)
+
+    def ack_many(self, item_ids):
+        with self._cv:
+            for item_id in item_ids:
+                self._release(item_id, all_entries=True)
 
     def get_result(self, item_id, timeout_s=10.0):
         deadline = time.time() + timeout_s
@@ -114,15 +243,49 @@ class InMemoryBroker(Broker):
         with self._cv:
             return len(self._q)
 
+    def oldest_age_s(self):
+        with self._cv:
+            ts = [row[3] for row in self._q]
+            ts += [row[2] for row in self._pel.values()]
+        return max(0.0, time.time() - min(ts)) if ts else 0.0
+
+    def heartbeat(self, worker_id, stats=None):
+        with self._cv:
+            self._hb[worker_id] = (time.time(), dict(stats or {}))
+
+    def clear_heartbeat(self, worker_id):
+        with self._cv:
+            self._hb.pop(worker_id, None)
+
+    def live_workers(self, ttl_s=3.0):
+        now = time.time()
+        with self._cv:
+            return {w: dict(s) for w, (t, s) in self._hb.items()
+                    if now - t <= ttl_s}
+
 
 class FileBroker(Broker):
-    """Spool-dir stream: input items are files under in/, claimed atomically
-    by rename into claimed/, results under out/<id>."""
+    """Spool-dir stream: input items are files under in/, claimed
+    atomically by rename into claimed/ (kept there, named
+    ``<consumer>~<entry>``, until the result publishes or the entry is
+    acked — the filesystem PEL), results under out/<id>, heartbeats under
+    hb/. A claimed file whose mtime goes idle past ``claim_idle_s`` is
+    requeued into in/ by the next claimer (XAUTOCLAIM parity), so a
+    SIGKILLed worker's in-flight entries re-deliver to survivors."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, consumer: Optional[str] = None,
+                 claim_idle_s: float = 30.0):
         self.root = root
-        for sub in ("in", "claimed", "out"):
+        for sub in ("in", "claimed", "out", "hb"):
             os.makedirs(os.path.join(root, sub), exist_ok=True)
+        self.consumer = consumer or f"fs-{uuid.uuid4().hex[:8]}"
+        self.claim_idle_s = float(claim_idle_s)
+        self.reclaimed = 0
+        # claimed paths per item, this handle only (the Redis broker's
+        # _pending_acks twin): a crashed process loses the map but its
+        # files stay in claimed/ where the idle requeue finds them
+        self._claimed: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
 
     def enqueue(self, item_id, payload):
         tmp = os.path.join(self.root, "in", f".tmp-{uuid.uuid4().hex}")
@@ -131,33 +294,91 @@ class FileBroker(Broker):
         os.replace(tmp, os.path.join(
             self.root, "in", f"{time.time_ns()}-{item_id}"))
 
+    def _requeue_stale(self):
+        # XAUTOCLAIM parity: a claimed file idle past claim_idle_s goes
+        # BACK into in/ under its original (timestamped) name, so the
+        # redelivery keeps its original stream position
+        cl_dir = os.path.join(self.root, "claimed")
+        now = time.time()
+        for n in os.listdir(cl_dir):
+            if "~" not in n:
+                continue
+            path = os.path.join(cl_dir, n)
+            try:
+                idle = now - os.path.getmtime(path)
+            except OSError:
+                continue        # acked/requeued by another consumer
+            if idle < self.claim_idle_s:
+                continue
+            try:
+                os.replace(path, os.path.join(
+                    self.root, "in", n.split("~", 1)[1]))
+            except OSError:
+                continue        # another consumer won the steal
+            self.reclaimed += 1
+
     def claim_batch(self, max_items, timeout_s):
         deadline = time.time() + timeout_s
+        in_dir = os.path.join(self.root, "in")
         while True:
-            names = sorted(n for n in os.listdir(
-                os.path.join(self.root, "in")) if not n.startswith("."))
+            self._requeue_stale()
+            names = sorted(n for n in os.listdir(in_dir)
+                           if not n.startswith("."))
             batch = []
             for n in names[:max_items]:
-                src = os.path.join(self.root, "in", n)
-                dst = os.path.join(self.root, "claimed", n)
+                src = os.path.join(in_dir, n)
+                dst = os.path.join(self.root, "claimed",
+                                   f"{self.consumer}~{n}")
                 try:
                     os.replace(src, dst)  # atomic claim
                 except OSError:
                     continue  # another worker won
+                # rename preserves mtime — restamp so idle time counts
+                # from the CLAIM, not the enqueue
+                os.utime(dst, None)
                 with open(dst, "rb") as f:
                     payload = f.read()
-                os.unlink(dst)
                 item_id = n.split("-", 1)[1]
+                with self._lock:
+                    self._claimed.setdefault(item_id, []).append(dst)
                 batch.append((item_id, payload))
             if batch or time.time() >= deadline:
                 return batch
             time.sleep(0.005)
+
+    def _unlink_claimed(self, item_id: str, all_entries: bool):
+        with self._lock:
+            paths = self._claimed.get(item_id)
+            if not paths:
+                return
+            take = list(paths) if all_entries else paths[:1]
+            left = paths[len(take):]
+            if left:
+                self._claimed[item_id] = left
+            else:
+                del self._claimed[item_id]
+        for path in take:
+            try:
+                os.unlink(path)
+            except OSError:
+                # requeued by another consumer after our claim went
+                # idle — the redelivery owns the entry now
+                logger.debug("file broker: claimed entry %s already "
+                             "requeued", path)
 
     def put_result(self, item_id, payload):
         tmp = os.path.join(self.root, "out", f".tmp-{uuid.uuid4().hex}")
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, os.path.join(self.root, "out", item_id))
+        self._unlink_claimed(item_id, all_entries=False)
+
+    def ack(self, item_id):
+        self._unlink_claimed(item_id, all_entries=True)
+
+    def ack_many(self, item_ids):
+        for item_id in item_ids:
+            self._unlink_claimed(item_id, all_entries=True)
 
     def get_result(self, item_id, timeout_s=10.0):
         path = os.path.join(self.root, "out", item_id)
@@ -174,6 +395,52 @@ class FileBroker(Broker):
     def pending(self):
         return len([n for n in os.listdir(os.path.join(self.root, "in"))
                     if not n.startswith(".")])
+
+    def oldest_age_s(self):
+        oldest = None
+        for sub in ("in", "claimed"):
+            for n in os.listdir(os.path.join(self.root, sub)):
+                if n.startswith("."):
+                    continue
+                base = n.split("~", 1)[1] if "~" in n else n
+                try:
+                    ts = int(base.split("-", 1)[0]) / 1e9
+                except ValueError:
+                    continue
+                oldest = ts if oldest is None else min(oldest, ts)
+        return max(0.0, time.time() - oldest) if oldest is not None else 0.0
+
+    def heartbeat(self, worker_id, stats=None):
+        doc = dict(stats or {})
+        doc["t"] = time.time()
+        tmp = os.path.join(self.root, "hb", f".tmp-{uuid.uuid4().hex}")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(self.root, "hb", worker_id))
+
+    def clear_heartbeat(self, worker_id):
+        try:
+            os.unlink(os.path.join(self.root, "hb", worker_id))
+        except OSError:
+            logger.debug("file broker: heartbeat %s already gone",
+                         worker_id)
+
+    def live_workers(self, ttl_s=3.0):
+        hb_dir = os.path.join(self.root, "hb")
+        now = time.time()
+        out = {}
+        for n in os.listdir(hb_dir):
+            if n.startswith("."):
+                continue
+            path = os.path.join(hb_dir, n)
+            try:
+                if now - os.path.getmtime(path) > ttl_s:
+                    continue
+                with open(path) as f:
+                    out[n] = json.load(f)
+            except (OSError, ValueError):
+                continue        # mid-replace or torn read: not live yet
+        return out
 
 
 class RedisBroker(Broker):
@@ -239,6 +506,8 @@ class RedisBroker(Broker):
         # leaves its entries in the group PEL where XAUTOCLAIM can steal them
         self._pending_acks: Dict[str, List[bytes]] = {}
         self._pending_lock = threading.Lock()
+        self.reclaimed = 0
+        self._hb_key = b"fleet:" + self.stream + b":hb"
         try:
             # the connect itself must ride the retry policy too (not just
             # the command): _conn() evaluated as an argument would put the
@@ -289,6 +558,7 @@ class RedisBroker(Broker):
                           for i in range(0, len(fields), 2)}
                     batch.append((kv[b"uri"].decode(), kv[b"data"]))
                     ids.append(eid)
+                    self.reclaimed += 1
             except self._RedisError:
                 pass  # pre-6.2 Redis has no XAUTOCLAIM; skip recovery
         if len(batch) < max_items:
@@ -407,6 +677,42 @@ class RedisBroker(Broker):
             in_flight = 0
         return max(backlog - in_flight, 0)
 
+    def oldest_age_s(self):
+        return self._retry.call(self._oldest_age_s)
+
+    def _oldest_age_s(self):
+        reply = self._conn().execute(
+            "XRANGE", self.stream, "-", "+", "COUNT", 1)
+        if not reply:
+            return 0.0
+        eid = reply[0][0]
+        ms = int(eid.split(b"-", 1)[0])
+        return max(0.0, time.time() - ms / 1000.0)
+
+    def heartbeat(self, worker_id, stats=None):
+        doc = dict(stats or {})
+        doc["t"] = time.time()
+        self._retry.call(self._conn().execute, "HSET", self._hb_key,
+                         worker_id, json.dumps(doc))
+
+    def clear_heartbeat(self, worker_id):
+        self._retry.call(self._conn().execute, "HDEL", self._hb_key,
+                         worker_id)
+
+    def live_workers(self, ttl_s=3.0):
+        flat = self._retry.call(self._conn().execute, "HGETALL",
+                                self._hb_key) or []
+        now = time.time()
+        out = {}
+        for i in range(0, len(flat), 2):
+            try:
+                doc = json.loads(flat[i + 1])
+            except ValueError:
+                continue
+            if now - float(doc.get("t", 0.0)) <= ttl_s:
+                out[flat[i].decode()] = doc
+        return out
+
     def close(self):
         with self._clients_lock:
             clients, self._clients = self._clients, []
@@ -416,16 +722,37 @@ class RedisBroker(Broker):
 
 def make_broker(spec: str = "memory://serving_stream") -> Broker:
     """Broker factory: ``memory://<stream>``, ``file://<dir>``, or
-    ``redis://host:port/<stream>`` (stream defaults to serving_stream)."""
+    ``redis://host:port/<stream>`` (stream defaults to serving_stream).
+
+    An optional ``?k=v`` query configures the transport — today
+    ``claim_idle_s`` (memory/file) / ``claim_idle_ms`` (redis), the idle
+    threshold past which a live consumer steals a dead consumer's pending
+    entries. It rides the spec string so every fleet process (supervisor,
+    spawned workers, frontends) that shares the spec shares the
+    configuration."""
+    spec, _, query = spec.partition("?")
+    params: Dict[str, str] = {}
+    if query:
+        for kv in query.split("&"):
+            k, _, v = kv.partition("=")
+            if k:
+                params[k] = v
     if spec.startswith("memory://"):
-        return InMemoryBroker.get(spec[len("memory://"):] or "serving_stream")
+        b = InMemoryBroker.get(spec[len("memory://"):] or "serving_stream")
+        if "claim_idle_s" in params:
+            b.claim_idle_s = float(params["claim_idle_s"])
+        return b
     if spec.startswith("file://"):
-        return FileBroker(spec[len("file://"):])
+        return FileBroker(
+            spec[len("file://"):],
+            claim_idle_s=float(params.get("claim_idle_s", 30.0)))
     if spec.startswith("redis://"):
         rest = spec[len("redis://"):]
         hostport, _, stream = rest.partition("/")
         host, _, port = hostport.partition(":")
         return RedisBroker(host or "127.0.0.1", int(port or 6379),
-                           stream or "serving_stream")
+                           stream or "serving_stream",
+                           claim_idle_ms=int(
+                               params.get("claim_idle_ms", 30000)))
     raise ValueError(f"unknown broker spec {spec} "
                      "(memory:// file:// or redis://)")

@@ -245,6 +245,34 @@ class _Handler(socketserver.BaseRequestHandler):
             st.cv.notify_all()
         self._bulk(eid)
 
+    @staticmethod
+    def _id_key(eid: bytes):
+        ms, _, seq = eid.partition(b"-")
+        return (int(ms), int(seq or b"0"))
+
+    def _cmd_xrange(self, st, args):
+        # XRANGE key start end [COUNT n] — enough for the brokers'
+        # head-of-line age probe (start '-', end '+', COUNT 1)
+        key, start, end = args[0], args[1], args[2]
+        count = None
+        for i, a in enumerate(args[3:]):
+            if a.upper() == b"COUNT":
+                count = int(args[3 + i + 1])
+        lo = None if start == b"-" else self._id_key(start)
+        hi = None if end == b"+" else self._id_key(end)
+        out = []
+        with st.cv:
+            s = st.streams.get(key)
+            for e in (s.entries if s else []):
+                if e is None:
+                    continue
+                k = self._id_key(e[0])
+                if (lo is None or k >= lo) and (hi is None or k <= hi):
+                    out.append([e[0], list(e[1])])
+                    if count is not None and len(out) >= count:
+                        break
+        self._array(out)
+
     def _cmd_xlen(self, st, args):
         with st.cv:
             s = st.streams.get(args[0])
@@ -441,6 +469,19 @@ class _Handler(socketserver.BaseRequestHandler):
         with st.cv:
             h = st.hashes.get(args[0], {})
             self._bulk(h.get(args[1]))
+
+    def _cmd_hdel(self, st, args):
+        key, fields = args[0], args[1:]
+        n = 0
+        with st.cv:
+            h = st.hashes.get(key)
+            if h:
+                for f in fields:
+                    if h.pop(f, None) is not None:
+                        n += 1
+                if not h:
+                    st.hashes.pop(key, None)
+        self._int(n)
 
     def _cmd_del(self, st, args):
         n = 0

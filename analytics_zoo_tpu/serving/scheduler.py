@@ -247,6 +247,15 @@ class ContinuousScheduler:
         with self._cv:
             return sum(len(q) for q in self._queues.values())
 
+    def oldest_wait_s(self) -> float:
+        """Seconds the longest-waiting admitted request has sat queued —
+        the worker-local half of the fleet's queue-age signal (the broker
+        half is ``oldest_age_s``: entries not yet claimed)."""
+        with self._cv:
+            t = min((req.t_admit for q in self._queues.values()
+                     for _, _, req in q.heap), default=None)
+        return 0.0 if t is None else max(0.0, time.time() - t)
+
     # --- batch forming (dispatch workers) -----------------------------------
     def next_batch(self, cap_fn: Callable[[str], int], idle_wait: float = 0.05
                    ) -> Optional[Tuple[str, List[ServingRequest]]]:

@@ -1038,6 +1038,7 @@ def _serving_scale_leg(broker, inputs, rate_rps, n_req, deadline_s, rng,
             "requests": n_req,
             "ok": counts["ok"], "shed": counts["shed"],
             "errors": counts["error"] + counts["lost"],
+            "lost": counts["lost"],
             "shed_rate": round(counts["shed"] / max(n_req, 1), 4),
             "goodput_rps": round(counts["ok"] / max(wall, 1e-9), 1),
             "p50_ms": round(float(np.percentile(lat_arr, 50) * 1e3), 2),
@@ -1213,6 +1214,223 @@ def bench_serving_scale(smoke: bool) -> dict:
             "busy_s_total": round(busy_total, 3),
             "cross_model_compiles": churn.get("compiles", 0),
             "batch_spans_recorded": int(batch_spans)}
+
+
+def bench_serving_fleet(smoke: bool) -> dict:
+    """ROADMAP open item 1 (scale-out serving tier): a TRUE multi-process
+    fleet — M spawned worker processes fanning over one Redis stream as a
+    consumer group, N HTTP frontends enqueuing into it. Workers run a
+    sleep-bound SleepModel (predict releases the GIL for ``batch_ms``), so
+    per-worker capacity is batch_size/batch_ms by construction and the
+    legs measure the TOPOLOGY (consumer-group fan-out, PEL reclaim, trace
+    propagation) rather than this host's arithmetic: a compute-bound toy
+    cannot scale across processes on a 1-core CI box, a chip-bound one
+    does — exactly the shared-nothing regime real TPU workers are in.
+
+    Legs: (1) single-worker saturated goodput g1; (2) M workers at M x the
+    same offered load -> gM, gate gM >= 0.8 x M x g1 (smoke: 2 workers,
+    >= 1.5 x g1); (3) 10x overload on one worker -> admitted p99 stays
+    deadline-bounded (EDF shed valve); (4) SIGKILL one of two workers
+    mid-run -> every request answered, lost == 0, survivor's PEL reclaim
+    > 0, supervisor respawns; (5) two frontends + traced requests -> one
+    trace id crosses frontend -> broker -> worker dispatch -> respond
+    across the process boundary (span files dumped by workers on drain)."""
+    import functools
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from analytics_zoo_tpu.obs import trace as _trace
+    from analytics_zoo_tpu.serving.fleet import ServingFleet, \
+        sleep_model_factory
+    from analytics_zoo_tpu.serving.http_frontend import create_app
+    from analytics_zoo_tpu.serving.queue_api import make_broker
+    from analytics_zoo_tpu.serving.redis_protocol import MiniRedisServer
+
+    batch_ms, bs = 100.0, 4
+    cap1 = bs / (batch_ms / 1e3)            # per-worker rps by construction
+    n_workers = 2 if smoke else 4
+    factory = functools.partial(sleep_model_factory, 2.0, batch_ms)
+    vec = np.arange(64, dtype=np.float32)
+    srv = MiniRedisServer(port=0)
+    srv.start()
+    host = f"127.0.0.1:{srv.port}"
+
+    def fleet_for(stream, workers, **kw):
+        spec = f"redis://{host}/{stream}?claim_idle_ms=800"
+        fleet = ServingFleet(
+            factory, spec, workers=workers, autoscale=False,
+            batch_size=bs, batch_timeout_ms=20.0,
+            # small per-worker admission bound: a worker may hold at most
+            # ~2 batches, so the backlog stays ON the stream where every
+            # consumer can claim it (the load-balancing half of the
+            # shared-nothing contract)
+            max_inflight=2 * bs,
+            heartbeat_s=0.25, worker_ttl_s=2.0, drain_s=10.0, **kw)
+        fleet.start()
+        if not fleet.wait_live(workers, 60.0):
+            raise RuntimeError(f"fleet {stream}: {workers} workers never "
+                               f"went live: {fleet.metrics()}")
+        return fleet, spec
+
+    def run_leg(stream, workers, rate, dur_s, deadline_s, seed,
+                kill_after_s=None, **kw):
+        fleet, spec = fleet_for(stream, workers, **kw)
+        broker = make_broker(spec)
+        killer = None
+        if kill_after_s is not None:
+            killer = threading.Timer(kill_after_s, fleet.kill_worker)
+            killer.daemon = True
+            killer.start()
+        try:
+            leg = _serving_scale_leg(
+                broker, {"default": vec}, rate,
+                max(int(rate * dur_s), 2 * bs), deadline_s,
+                np.random.RandomState(seed), n_fetchers=12)
+        finally:
+            if killer is not None:
+                killer.cancel()
+            snap = fleet.stop()
+            broker.close()
+        leg["workers"] = workers
+        return leg, snap
+
+    try:
+        dur = 3.0 if smoke else 4.0
+        # saturating offered load (1.5x capacity): goodput == what the
+        # worker set actually serves, independent of generator pacing
+        leg1, _ = run_leg("fl1", 1, 1.5 * cap1, dur, 2.5, 201)
+        legN, _ = run_leg("flN", n_workers, 1.5 * cap1 * n_workers, dur,
+                          2.5, 202)
+        g1, gN = leg1["goodput_rps"], legN["goodput_rps"]
+        linear_frac = gN / max(n_workers * g1, 1e-9)
+
+        # 10x overload on one worker: EDF + deadline shed keep ADMITTED
+        # p99 bounded while the shed valve absorbs the rest
+        over_deadline = 0.6
+        leg10, _ = run_leg("flo", 1, 10 * cap1, 1.5, over_deadline, 203)
+        p99_bounded = bool(
+            leg10["p99_ms"] <= over_deadline * 1e3 + 150.0)
+
+        # chaos: SIGKILL one of two workers mid-run. The dead consumer's
+        # pending entries idle out and the survivor's XAUTOCLAIM steals
+        # them — every request answered, zero silently lost; the
+        # supervisor respawns the dead slot
+        chaos_rate = 0.6 * 2 * cap1
+        leg_k, snap_k = run_leg("flc", 2, chaos_rate, 3.0, 8.0, 204,
+                                kill_after_s=1.2)
+        chaos = {"requests": leg_k["requests"], "ok": leg_k["ok"],
+                 "shed": leg_k["shed"], "lost": leg_k["lost"],
+                 "reclaimed": snap_k["reclaimed_total"],
+                 "restarts": snap_k["restarts"]}
+
+        # trace chain across processes: two frontends (N doors), traced
+        # requests, workers dump their spans on drain; one trace id must
+        # run frontend -> broker -> worker dispatch -> respond
+        trace_dir = tempfile.mkdtemp(prefix="fleet_spans_")
+        fleet_t, spec_t = fleet_for(
+            "flt", 2, worker_env={"ZOO_TRACE": "1"}, trace_dir=trace_dir)
+        fronts = []
+        try:
+            for _ in range(2):
+                fronts.append(_frontend_thread(
+                    create_app(spec_t, timeout_s=10.0, worker_ttl_s=2.0)))
+            req_traces = set()
+            with _trace.tracing(capacity=4096):
+                for i in range(8):
+                    port = fronts[i % 2][0]
+                    body = _json.dumps(
+                        {"instances": [vec.tolist()]}).encode()
+                    r = urllib.request.urlopen(urllib.request.Request(
+                        f"http://127.0.0.1:{port}/predict", data=body,
+                        headers={"Content-Type": "application/json"}),
+                        timeout=15)
+                    assert r.status == 200, r.status
+                ready = urllib.request.urlopen(
+                    f"http://127.0.0.1:{fronts[0][0]}/readyz", timeout=5)
+                assert ready.status == 200
+                req_traces = {s.trace_id for s in _trace.spans()
+                              if s.name == "serving.request"}
+        finally:
+            for _port, stop in fronts:
+                stop()
+            fleet_t.stop()
+        worker_chains = {}
+        for fn in os.listdir(trace_dir):
+            with open(os.path.join(trace_dir, fn)) as f:
+                for line in f:
+                    s = _json.loads(line)
+                    if s["name"] in ("serving.dispatch", "serving.respond"):
+                        worker_chains.setdefault(
+                            s["trace"], set()).add(s["name"])
+        chained = [t for t in req_traces
+                   if worker_chains.get(t) == {"serving.dispatch",
+                                               "serving.respond"}]
+        trace_chain_ok = bool(chained)
+    finally:
+        srv.stop()
+
+    return {"metric": "serving_fleet_scaleout",
+            "value": round(linear_frac, 3),
+            "unit": f"x of linear 1->{n_workers}-worker goodput",
+            "vs_baseline": round(linear_frac, 3),
+            "baseline_note": "baseline = perfectly linear scaling from "
+                             "the measured single-worker goodput "
+                             "(shared-nothing ideal)",
+            "workers": n_workers,
+            "per_worker_capacity_rps": cap1,
+            "goodput_1w_rps": g1,
+            f"goodput_{n_workers}w_rps": gN,
+            "scaleout_x": round(gN / max(g1, 1e-9), 3),
+            "legs": {"1w": leg1, f"{n_workers}w": legN, "10x_1w": leg10,
+                     "chaos_2w": leg_k},
+            "p99_admitted_ms_10x": leg10["p99_ms"],
+            "p99_bounded_10x": p99_bounded,
+            "deadline_ms_10x": over_deadline * 1e3,
+            "chaos": chaos,
+            "frontends": 2,
+            "trace_chain_ok": trace_chain_ok,
+            "trace_ids_chained": len(chained),
+            "trace_ids_requested": len(req_traces)}
+
+
+def _frontend_thread(app):
+    """Run an aiohttp app on an ephemeral port in a daemon thread; returns
+    ``(port, stop)``. The fleet bench uses two of these as the N frontend
+    doors of the scale-out topology."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+    runner = web.AppRunner(app)
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True, name="fleet-frontend")
+    t.start()
+    if not started.wait(15):
+        raise RuntimeError("frontend thread failed to start")
+
+    def stop():
+        async def _cleanup():
+            await runner.cleanup()
+        asyncio.run_coroutine_threadsafe(_cleanup(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+
+    return holder["port"], stop
 
 
 def bench_attention(smoke: bool) -> dict:
@@ -2627,6 +2845,7 @@ def main():
                "fraud_mlp": bench_fraud_mlp, "autots": bench_autots_trials,
                "serving_od": bench_serving_od,
                "serving_scale": bench_serving_scale,
+               "serving_fleet": bench_serving_fleet,
                "attention": bench_attention,
                "compile_plane": bench_compile_plane,
                "infeed": bench_infeed, "ckpt": bench_ckpt,
@@ -2673,6 +2892,7 @@ def main():
     for name, key in (("ncf", "ncf"), ("fraud_mlp", "fraud_mlp"),
                       ("autots", "autots"), ("serving_od", "serving_od"),
                       ("serving_scale", "serving_scale"),
+                      ("serving_fleet", "serving_fleet"),
                       ("attention", "flash_attention_speedup"),
                       ("compile_plane", "compile_warm_start"),
                       ("infeed", "infeed_wire_reduction"),

@@ -29,15 +29,15 @@ if [ -n "$fails" ]; then
     printf 'DOTS_FAILED_ID=%s\n' $fails
 fi
 # per-plane snapshot lines (TRANSFER_PLANE= / CKPT_PLANE= / COMMS_PLANE= /
-# SHARDING_PLANE= / RESILIENCE= / SERVING_PLANE= / STREAMING= / ANALYSIS= /
-# OBS=): tiny CPU
+# SHARDING_PLANE= / RESILIENCE= / SERVING_PLANE= / FLEET= / STREAMING= /
+# ANALYSIS= / OBS=): tiny CPU
 # workloads through each plane's
 # production path, all through the ONE zoo-metrics snapshot codepath
 # (analytics_zoo_tpu/obs/snapshots.py — previously five bespoke heredocs
 # here). One process per plane: the comms/analysis snapshots configure the
 # 8-device simulated mesh themselves, which must happen before the JAX
 # backend first initializes. Never affects the exit code.
-for plane in transfer ckpt comms sharding resilience serving streaming analysis obs; do
+for plane in transfer ckpt comms sharding resilience serving fleet streaming analysis obs; do
     env JAX_PLATFORMS=cpu \
         python -m analytics_zoo_tpu.obs snapshot "$plane" \
         2>/dev/null | grep -aE '^[A-Z_]+=' || true
