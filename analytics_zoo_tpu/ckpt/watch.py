@@ -51,6 +51,13 @@ class CheckpointWatcher:
 
     # --- polling ------------------------------------------------------------
     def _latest_committed(self):
+        # max-step selection by STEP NUMBER only, never scan or mtime
+        # order: with multiple producers committing into one watch root
+        # (fleet-scale streaming: a respawned trainer re-commits while
+        # its peers race ahead) os.listdir order and directory mtimes
+        # are meaningless — a lagging producer's freshly *written* dir
+        # carries the newest mtime but an OLD step, and adopting it
+        # would roll live serving backwards
         best = (None, -1)
         for step, path in fmt.loadable_step_dirs(self.root):
             if step > self.last_step and step > best[1]:
@@ -69,6 +76,14 @@ class CheckpointWatcher:
         path, step = self._latest_committed()
         if path is None:
             return False
+        if step <= self.last_step:
+            # monotonic-adoption invariant, re-checked at the delivery
+            # edge: whatever the scan returned, the consumer NEVER sees
+            # a step at or below the one it already serves (the scan
+            # filter and this guard can only disagree if last_step moved
+            # between them — e.g. a subclass or rollout hook bumping it
+            # while a poll is in flight)
+            return False
         try:
             state = fmt.load_checkpoint_dir(path, self.passphrase)
         except Exception as e:      # noqa: BLE001 — retry next poll
@@ -86,9 +101,11 @@ class CheckpointWatcher:
             logger.warning("hot-reload: consumer rejected checkpoint %s "
                            "(%s: %s); skipping step %d",
                            path, type(e).__name__, e, step)
-            self.last_step = step
+            self.last_step = max(self.last_step, step)
             return False
-        self.last_step = step
+        # max(), not plain assignment: last_step must never move
+        # backwards, even against a concurrent manual bump
+        self.last_step = max(self.last_step, step)
         return True
 
     # --- lifecycle ----------------------------------------------------------

@@ -208,6 +208,31 @@ _KNOBS = [
     _k("ZOO_STREAM_POLL_TIMEOUT_S", "float", 0.2, "streaming",
        "Blocking-claim timeout per broker poll while a window "
        "accumulates."),
+    _k("ZOO_STREAM_CONSUMERS", "int", 1, "streaming",
+       "Trainer-process count a StreamingFleet spawns — one shared-"
+       "nothing consumer per stream partition, each committing into its "
+       "own per-partition checkpoint namespace."),
+    _k("ZOO_STREAM_PARTITION_BY", "str", "key", "streaming",
+       "What routes a record to its partition at the fan-out broker: "
+       "key (the producer-stamped record key, falling back to the id "
+       "for keyless records) | id (always the record id — uniform "
+       "spread, but one logical key may straddle partitions)."),
+    _k("ZOO_STREAM_GUARD_HOLDOUT", "int", 256, "streaming",
+       "Sliding holdout-window capacity (records) the online guardrail "
+       "scores every streaming commit against before serving adopts "
+       "it."),
+    _k("ZOO_STREAM_GUARD_MIN_HOLDOUT", "int", 64, "streaming",
+       "Below this many holdout records the guardrail verdict is "
+       "'insufficient': the commit is adopted (bootstrap must not "
+       "stall) but counted."),
+    _k("ZOO_STREAM_GUARD_REGRESSION", "float", 0.2, "streaming",
+       "Relative score regression vs the baseline (best recently-"
+       "accepted score) that REJECTS adoption: reject when score > "
+       "baseline * (1 + this)."),
+    _k("ZOO_STREAM_GUARD_BASELINE_WINDOW", "int", 8, "streaming",
+       "Accepted-commit scores retained for the guardrail baseline "
+       "(best-of window; rejected scores never enter it, so one bad "
+       "window cannot ratchet the bar down)."),
     # --- multihost ----------------------------------------------------------
     _k("ZOO_COORDINATOR", "str", None, "multihost",
        "host:port of the jax.distributed coordinator for multi-process "

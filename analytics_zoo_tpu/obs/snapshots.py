@@ -607,6 +607,7 @@ def snapshot_streaming() -> int:
         if est is not None:
             est.shutdown()
         srv.stop()
+    fleet_info = _snapshot_streaming_fleet()
     return _emit("STREAMING", {
         "windows": snap["windows"],
         "records_trained": snap["records_trained"],
@@ -614,7 +615,127 @@ def snapshot_streaming() -> int:
         "freshness_lag_s": snap.get("last_freshness_lag_s"),
         "reloads": snap["reloads"],
         "recompiles_after_warm": snap["recompiles_after_warm"],
-        "trace_ok": len(chained) >= 1})
+        "trace_ok": len(chained) >= 1,
+        "fleet": fleet_info})
+
+
+def _snapshot_streaming_fleet() -> Dict:
+    """The PR-19 scale-out story at snapshot size: a 2-consumer
+    StreamingFleet over keyed sub-streams (per-consumer freshness skew —
+    worst/best p99 across partitions, ~1.0 when the key hash balances),
+    plus one guardrail-reject exercise (a poisoned commit scored on a
+    clean holdout must be rejected and never adopted)."""
+    import functools
+    import shutil
+    import time
+
+    import numpy as np
+
+    from ..serving.queue_api import make_broker
+    from ..serving.redis_protocol import MiniRedisServer
+    from ..streaming import (FleetReloaders, GuardrailEvaluator,
+                             StreamingFleet, StreamingReloader,
+                             StreamingTrainer, StreamingXShards,
+                             encode_record, partition_for, seq_id)
+    from ..streaming.fleet import linear_estimator_factory
+    from ..streaming.guardrail import module_loss_scorer
+
+    class _Sink:
+        def __init__(self):
+            self.steps = []
+
+        def apply_checkpoint(self, path, state, step):
+            self.steps.append(int(step))
+
+    w_true = (np.arange(8) / 8.0).astype(np.float32)
+    srv = MiniRedisServer(port=0).start()
+    root = tempfile.mkdtemp(prefix="zoo-snap-fleet-")
+    guard_dir = tempfile.mkdtemp(prefix="zoo-snap-guard-")
+    fleet = guard_est = None
+    try:
+        # --- 2-consumer fleet over keyed sub-streams ----------------------
+        spec = f"redis://127.0.0.1:{srv.port}/snapf?claim_idle_ms=500"
+        prod = make_broker(f"{spec}&partitions=2")
+        keys = {0: next(f"k{j}" for j in range(64)
+                        if partition_for(f"k{j}", 2) == 0),
+                1: next(f"k{j}" for j in range(64)
+                        if partition_for(f"k{j}", 2) == 1)}
+        rng = np.random.RandomState(1)
+        for i in range(64):             # 2 windows of 16 per partition
+            x = rng.rand(8).astype(np.float32)
+            prod.enqueue(seq_id(i), encode_record(
+                x, np.float32([x @ w_true]), event_time=time.time(),
+                key=keys[i % 2]))
+        fleet = StreamingFleet(
+            functools.partial(linear_estimator_factory, dim=8),
+            spec, root, consumers=2, batch_size=16, window_records=16,
+            poll_timeout_s=0.05, idle_timeout_s=5.0, heartbeat_s=0.2)
+        fleet.start()
+        m = {}
+        if fleet.join(timeout_s=180):
+            m = fleet.stop()
+        reloaders = FleetReloaders({0: _Sink(), 1: _Sink()}, root,
+                                   poll_s=60)
+        reloaders.poll_now()
+        p99s = [v for v in
+                reloaders.freshness_p99_by_consumer().values()
+                if v is not None]
+        reloaders.stop()
+        ratio = (round(max(p99s) / max(min(p99s), 1e-9), 3)
+                 if len(p99s) == 2 else None)
+
+        # --- guardrail: poisoned commit rejected, never adopted -----------
+        guard_est = linear_estimator_factory(dim=8, lr=0.3)
+        gprod = make_broker(f"redis://127.0.0.1:{srv.port}/snapg")
+        gsrc = StreamingXShards(
+            f"redis://127.0.0.1:{srv.port}/snapg",
+            batch_size=16, window_records=32, poll_timeout_s=0.05)
+        gtr = StreamingTrainer(guard_est, gsrc, guard_dir)
+        guard = GuardrailEvaluator(
+            module_loss_scorer(guard_est.module), holdout_records=32,
+            min_holdout=16, regression=0.5)
+        grng = np.random.RandomState(2)
+        for _ in range(32):
+            x = grng.rand(8).astype(np.float32)
+            guard.observe(x, np.float32([x @ w_true]))
+        gsink = _Sink()
+        grel = StreamingReloader(gsink, guard_dir, poll_s=60,
+                                 start_at=-1, guard=guard)
+        gi = [0]
+
+        def g_window(poison):
+            for _ in range(32):
+                x = grng.rand(8).astype(np.float32)
+                y = x @ w_true + (10.0 if poison else 0.0)
+                gprod.enqueue(seq_id(gi[0]), encode_record(
+                    x, np.float32([y]), event_time=time.time()))
+                gi[0] += 1
+
+        g_window(poison=False)
+        gtr.run(max_windows=1, idle_timeout_s=5.0)
+        grel.poll_now()                 # clean commit: accepted + adopted
+        g_window(poison=True)
+        gtr.run(max_windows=1, idle_timeout_s=5.0)
+        poisoned_step = int(guard_est.engine.step)
+        grel.poll_now()                 # poisoned commit: rejected
+        gsnap = grel.stats.snapshot()
+        return {
+            "consumers": int(m.get("consumers", 2)),
+            "windows_total": int(m.get("windows_total", 0)),
+            "freshness_p99_ratio": ratio,
+            "guard_rejected": int(gsnap.get("guard_rejected", 0)),
+            "guard_accepted": int(gsnap.get("guard_accepted", 0)),
+            "rejected_never_adopted": bool(
+                poisoned_step not in gsink.steps),
+        }
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        if guard_est is not None:
+            guard_est.shutdown()
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(guard_dir, ignore_errors=True)
 
 
 PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,

@@ -2,13 +2,15 @@ from .client import InputQueue, OutputQueue
 from .codecs import SparseTensor
 from .engine import ClusterServing, Timer
 from .fleet import Autoscaler, ServingFleet, SleepModel, sleep_model_factory
-from .queue_api import FileBroker, InMemoryBroker, RedisBroker, make_broker
+from .queue_api import (FileBroker, InMemoryBroker, PartitionedBroker,
+                        RedisBroker, make_broker, partitioned_spec)
 from .redis_protocol import MiniRedisServer, RedisClient
 from .scheduler import ContinuousScheduler, ModelMultiplexer
 
 __all__ = ["InputQueue", "OutputQueue", "ClusterServing", "Timer",
            "InMemoryBroker", "FileBroker", "RedisBroker", "MiniRedisServer",
-           "RedisClient", "make_broker", "SparseTensor",
+           "RedisClient", "make_broker", "partitioned_spec",
+           "PartitionedBroker", "SparseTensor",
            "ContinuousScheduler", "ModelMultiplexer",
            "ServingFleet", "Autoscaler", "SleepModel",
            "sleep_model_factory"]

@@ -720,16 +720,167 @@ class RedisBroker(Broker):
             c.close()
 
 
+class PartitionedBroker(Broker):
+    """Producer-side fan-out over N keyed sub-streams of one broker spec.
+
+    ``make_broker("redis://h:p/s?partitions=4")`` returns one of these:
+    :meth:`enqueue` routes each record to sub-stream ``s.p{k}`` by its
+    routing key (``streaming.records.record_key``, CRC32-hashed — the
+    same deterministic hash every consumer uses), falling back to the
+    item id for keyless payloads, so all records of one key land on ONE
+    partition in stream order — the invariant that keeps per-partition
+    cursors and bit-exact replay meaningful at fleet scale. Consumers do
+    NOT go through this class: each fleet trainer opens its own
+    ``...?partition=k`` sub-broker and claims only its shard (disjoint by
+    construction — different partitions are different streams).
+
+    The aggregate read surface (:meth:`pending`, :meth:`oldest_age_s`,
+    :meth:`live_workers`) merges across partitions so supervisors and
+    frontends see whole-stream numbers; :meth:`claim_batch` round-robins
+    the partitions (a single-consumer reader of a partitioned stream,
+    used by coverage tests and drain tooling, not the fleet hot path).
+    """
+
+    def __init__(self, parts: List[Broker],
+                 partition_by: Optional[str] = None):
+        if not parts:
+            raise ValueError("PartitionedBroker needs >= 1 partition")
+        from ..common import knobs as _knobs
+        self.parts = list(parts)
+        self.partition_by = str(
+            partition_by if partition_by is not None
+            else _knobs.get("ZOO_STREAM_PARTITION_BY"))
+        if self.partition_by not in ("key", "id"):
+            raise ValueError(
+                f"partition_by must be 'key' or 'id', "
+                f"got {self.partition_by!r}")
+        self._rr = 0
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.parts)
+
+    @property
+    def reclaimed(self) -> int:
+        # derived, read-only: the per-partition consumers own the counts
+        return sum(int(getattr(p, "reclaimed", 0)) for p in self.parts)
+
+    def partition_of(self, item_id: str, payload: bytes) -> int:
+        """Partition index a record routes to: the record's stamped key
+        when it carries one, else the item id (both through the same
+        process-stable CRC32 hash)."""
+        # lazy import: streaming.records is leaf-level, but importing the
+        # streaming package from this module's top level would cycle back
+        # through streaming.source -> queue_api
+        from ..streaming.records import partition_for, record_key
+        key = None
+        if self.partition_by == "key" and \
+                isinstance(payload, (bytes, bytearray)) and \
+                payload[:4] == b"ZSR1":
+            try:
+                key = record_key(bytes(payload))
+            except ValueError:
+                key = None
+        return partition_for(key if key is not None else item_id,
+                             len(self.parts))
+
+    def enqueue(self, item_id, payload):
+        self.parts[self.partition_of(item_id, payload)].enqueue(
+            item_id, payload)
+
+    def claim_batch(self, max_items, timeout_s):
+        deadline = time.time() + timeout_s
+        while True:
+            for i in range(len(self.parts)):
+                part = self.parts[(self._rr + i) % len(self.parts)]
+                batch = part.claim_batch(max_items, 0.0)
+                if batch:
+                    self._rr = (self._rr + i + 1) % len(self.parts)
+                    return batch
+            if time.time() >= deadline:
+                return []
+            time.sleep(0.005)
+
+    def ack(self, item_id):
+        # the router knows where a PAYLOAD goes, not where an id was
+        # claimed; ack is idempotent on every transport, so fan it out
+        for p in self.parts:
+            p.ack(item_id)
+
+    def ack_many(self, item_ids):
+        ids = list(item_ids)
+        for p in self.parts:
+            p.ack_many(ids)
+
+    def put_result(self, item_id, payload):
+        from ..streaming.records import partition_for
+        self.parts[partition_for(item_id, len(self.parts))].put_result(
+            item_id, payload)
+
+    def get_result(self, item_id, timeout_s=10.0):
+        from ..streaming.records import partition_for
+        return self.parts[partition_for(
+            item_id, len(self.parts))].get_result(item_id, timeout_s)
+
+    def pending(self):
+        return sum(p.pending() for p in self.parts)
+
+    def oldest_age_s(self):
+        return max((p.oldest_age_s() for p in self.parts), default=0.0)
+
+    def heartbeat(self, worker_id, stats=None):
+        self.parts[0].heartbeat(worker_id, stats)
+
+    def clear_heartbeat(self, worker_id):
+        self.parts[0].clear_heartbeat(worker_id)
+
+    def live_workers(self, ttl_s=3.0):
+        out: Dict[str, Dict] = {}
+        for p in self.parts:
+            out.update(p.live_workers(ttl_s))
+        return out
+
+    def close(self):
+        for p in self.parts:
+            close = getattr(p, "close", None)
+            if close is not None:
+                close()
+
+
+def partitioned_spec(spec: str, partition: int) -> str:
+    """``spec`` narrowed to one partition's sub-stream — the string a
+    fleet supervisor hands each consumer process (query params carried by
+    the base spec, e.g. ``claim_idle_ms``, ride along)."""
+    base, _, query = spec.partition("?")
+    keep = [kv for kv in query.split("&")
+            if kv and kv.split("=", 1)[0] not in ("partition", "partitions")]
+    keep.append(f"partition={int(partition)}")
+    return base + "?" + "&".join(keep)
+
+
 def make_broker(spec: str = "memory://serving_stream") -> Broker:
     """Broker factory: ``memory://<stream>``, ``file://<dir>``, or
     ``redis://host:port/<stream>`` (stream defaults to serving_stream).
 
-    An optional ``?k=v`` query configures the transport — today
-    ``claim_idle_s`` (memory/file) / ``claim_idle_ms`` (redis), the idle
-    threshold past which a live consumer steals a dead consumer's pending
-    entries. It rides the spec string so every fleet process (supervisor,
-    spawned workers, frontends) that shares the spec shares the
-    configuration."""
+    An optional ``?k=v`` query configures the transport — it rides the
+    spec string so every fleet process (supervisor, spawned workers,
+    frontends) that shares the spec shares the configuration:
+
+    * ``claim_idle_s`` (memory/file) / ``claim_idle_ms`` (redis) — the
+      idle threshold past which a live consumer steals a dead consumer's
+      pending entries;
+    * ``partition=k`` — open partition ``k``'s keyed sub-stream (memory:
+      ``<name>.p<k>``; file: ``<dir>/p<k>``; redis: ``<stream>.p<k>`` —
+      the same naming on all three transports, so tests move freely
+      between them). This is the consumer-side handle: a fleet trainer
+      claims only its shard;
+    * ``partitions=N`` — the producer-side fan-out: a
+      :class:`PartitionedBroker` routing each record onto one of the N
+      sub-streams by its stamped key (id hash for keyless payloads).
+
+    ``partition`` and ``partitions`` are mutually exclusive (a handle is
+    either one shard or the router over all of them)."""
+    spec_full = spec
     spec, _, query = spec.partition("?")
     params: Dict[str, str] = {}
     if query:
@@ -737,22 +888,63 @@ def make_broker(spec: str = "memory://serving_stream") -> Broker:
             k, _, v = kv.partition("=")
             if k:
                 params[k] = v
-    if spec.startswith("memory://"):
-        b = InMemoryBroker.get(spec[len("memory://"):] or "serving_stream")
+
+    for prefix in ("memory://", "file://", "redis://"):
+        if spec.startswith(prefix):
+            transport = prefix[:-3]
+            break
+    else:
+        raise ValueError(f"unknown broker spec {spec} "
+                         "(memory:// file:// or redis://)")
+
+    def _int_param(name: str, minimum: int) -> Optional[int]:
+        raw = params.get(name)
+        if raw is None:
+            return None
+        try:
+            v = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{transport} broker: ?{name}={raw!r} is not an integer "
+                f"(spec {spec_full!r})") from None
+        if v < minimum:
+            raise ValueError(
+                f"{transport} broker: ?{name}={v} must be >= {minimum} "
+                f"(spec {spec_full!r})")
+        return v
+
+    partition = _int_param("partition", 0)
+    partitions = _int_param("partitions", 1)
+    if partition is not None and partitions is not None:
+        raise ValueError(
+            f"{transport} broker: ?partition= (one shard) and "
+            f"?partitions= (the fan-out router) are mutually exclusive "
+            f"(spec {spec_full!r})")
+    if partitions is not None:
+        return PartitionedBroker(
+            [make_broker(partitioned_spec(spec_full, k))
+             for k in range(partitions)])
+
+    if transport == "memory":
+        name = spec[len("memory://"):] or "serving_stream"
+        if partition is not None:
+            name = f"{name}.p{partition}"
+        b = InMemoryBroker.get(name)
         if "claim_idle_s" in params:
             b.claim_idle_s = float(params["claim_idle_s"])
         return b
-    if spec.startswith("file://"):
+    if transport == "file":
+        root = spec[len("file://"):]
+        if partition is not None:
+            root = os.path.join(root, f"p{partition}")
         return FileBroker(
-            spec[len("file://"):],
-            claim_idle_s=float(params.get("claim_idle_s", 30.0)))
-    if spec.startswith("redis://"):
-        rest = spec[len("redis://"):]
-        hostport, _, stream = rest.partition("/")
-        host, _, port = hostport.partition(":")
-        return RedisBroker(host or "127.0.0.1", int(port or 6379),
-                           stream or "serving_stream",
-                           claim_idle_ms=int(
-                               params.get("claim_idle_ms", 30000)))
-    raise ValueError(f"unknown broker spec {spec} "
-                     "(memory:// file:// or redis://)")
+            root, claim_idle_s=float(params.get("claim_idle_s", 30.0)))
+    rest = spec[len("redis://"):]
+    hostport, _, stream = rest.partition("/")
+    host, _, port = hostport.partition(":")
+    stream = stream or "serving_stream"
+    if partition is not None:
+        stream = f"{stream}.p{partition}"
+    return RedisBroker(host or "127.0.0.1", int(port or 6379), stream,
+                       claim_idle_ms=int(
+                           params.get("claim_idle_ms", 30000)))

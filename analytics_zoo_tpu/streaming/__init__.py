@@ -12,19 +12,38 @@ Cluster Serving streaming) end to end:
     InferenceModel, zero new compiles) -> fresher predictions, in
     seconds.
 
+At fleet scale (PR 19) the single trainer becomes a
+:class:`~analytics_zoo_tpu.streaming.fleet.StreamingFleet`: records
+carry a partition key (``encode_record(key=...)``), the stream shards
+into keyed sub-streams (``?partitions=N``), N shared-nothing trainer
+processes each run the loop above on their shard, and
+:class:`~analytics_zoo_tpu.streaming.fleet.FleetReloaders` adopts each
+partition's freshest committed step — optionally through a
+:class:`~analytics_zoo_tpu.streaming.guardrail.GuardrailEvaluator` that
+scores every commit on a holdout window and rejects regressions before
+they reach traffic.
+
 See ``docs/guides/streaming.md`` for window/watermark semantics, the
-cursor contract, and the freshness SLO; ``examples/streaming/
-online_ncf.py`` runs the whole tree in one process against the bundled
-MiniRedisServer.
+cursor contract, scale-out partitioning and the freshness SLO;
+``examples/streaming/online_ncf.py`` runs the single-trainer tree in
+one process against the bundled MiniRedisServer, and
+``examples/streaming/zouwu_forecast.py`` rides a Zouwu forecaster on
+the same plane.
 """
 
-from .records import decode_record, encode_record, seq_id  # noqa: F401
+from .fleet import FleetReloaders, StreamingFleet          # noqa: F401
+from .guardrail import (GuardrailEvaluator,                # noqa: F401
+                        GuardrailRejected, module_loss_scorer)
+from .records import (decode_record, encode_record,        # noqa: F401
+                      partition_for, record_key, seq_id)
 from .serve import StreamingReloader                       # noqa: F401
 from .source import (StreamCursor, StreamingXShards,       # noqa: F401
                      Window)
 from .stats import StreamingStats                          # noqa: F401
 from .trainer import StreamingTrainer                      # noqa: F401
 
-__all__ = ["encode_record", "decode_record", "seq_id", "StreamCursor",
-           "Window", "StreamingXShards", "StreamingTrainer",
-           "StreamingReloader", "StreamingStats"]
+__all__ = ["encode_record", "decode_record", "seq_id", "record_key",
+           "partition_for", "StreamCursor", "Window", "StreamingXShards",
+           "StreamingTrainer", "StreamingReloader", "StreamingStats",
+           "StreamingFleet", "FleetReloaders", "GuardrailEvaluator",
+           "GuardrailRejected", "module_loss_scorer"]

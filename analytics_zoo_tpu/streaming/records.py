@@ -13,16 +13,28 @@ if ids are lexicographically monotonic in stream order — :func:`seq_id`
 renders a producer sequence number into such an id; producers with their
 own id scheme must preserve the same property (documented in
 ``docs/guides/streaming.md``, "cursor contract").
+
+Records may additionally carry a **key** (``encode_record(key=...)``) —
+the sharding handle of the fleet-scale plane: a producer stamps each
+record with its routing identity (model name, user cohort, series id)
+and :func:`partition_for` maps it deterministically onto one of N
+partitions. The hash is CRC32, NOT Python ``hash()``: every producer
+and consumer process must agree on the mapping across interpreter
+restarts and hosts (PYTHONHASHSEED randomizes ``hash()`` per process).
+:func:`record_key` reads the key header-only — the partition router on
+the enqueue hot path never touches the array payload.
 """
 
 from __future__ import annotations
 
 import json
+import zlib
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["encode_record", "decode_record", "seq_id"]
+__all__ = ["encode_record", "decode_record", "seq_id", "record_key",
+           "partition_for"]
 
 _MAGIC = b"ZSR1"
 
@@ -51,11 +63,14 @@ def _as_tuple(v) -> Tuple[np.ndarray, ...]:
     return (_contig(v),)
 
 
-def encode_record(x, y=None, event_time: Optional[float] = None) -> bytes:
+def encode_record(x, y=None, event_time: Optional[float] = None,
+                  key: Optional[str] = None) -> bytes:
     """Encode one training example. ``x``/``y`` are arrays or tuples of
     arrays (per-example shape, no batch dim); ``event_time`` defaults to
     0.0 — producers should stamp their own clock so freshness lag is
-    measured from the event, not from ingestion."""
+    measured from the event, not from ingestion. ``key`` is the optional
+    routing identity (:func:`partition_for` shards on it); keyless
+    records fall back to id-hash routing at the partitioned broker."""
     xs, ys = _as_tuple(x), _as_tuple(y)
     header = {
         "t": float(event_time) if event_time is not None else 0.0,
@@ -63,6 +78,8 @@ def encode_record(x, y=None, event_time: Optional[float] = None) -> bytes:
         "y": ([{"shape": list(a.shape), "dtype": a.dtype.str} for a in ys]
               if y is not None else None),
     }
+    if key is not None:
+        header["k"] = str(key)
     head = json.dumps(header, separators=(",", ":")).encode("utf-8")
     parts = [_MAGIC, len(head).to_bytes(4, "big"), head]
     for a in xs + ys:
@@ -96,3 +113,27 @@ def decode_record(raw: bytes
     xs = take(header["x"])
     ys = take(header["y"]) if header["y"] is not None else None
     return xs, ys, float(header["t"])
+
+
+def record_key(raw: bytes) -> Optional[str]:
+    """The routing key of an encoded record, or None when the producer
+    stamped none. Header-only: the partition router calls this once per
+    enqueue and must not pay an array decode."""
+    if raw[:4] != _MAGIC:
+        raise ValueError("not a streaming record (bad magic)")
+    hlen = int.from_bytes(raw[4:8], "big")
+    k = json.loads(raw[8:8 + hlen].decode("utf-8")).get("k")
+    return None if k is None else str(k)
+
+
+def partition_for(key: str, n_partitions: int) -> int:
+    """Deterministic key -> partition index in ``[0, n_partitions)``.
+
+    CRC32 of the UTF-8 key, mod N — stable across processes, hosts and
+    interpreter restarts (unlike ``hash()``, which PYTHONHASHSEED salts
+    per process), so every producer routes a key to the same partition
+    and every consumer's cursor stays meaningful across restarts."""
+    n = int(n_partitions)
+    if n <= 0:
+        raise ValueError(f"n_partitions must be >= 1, got {n_partitions}")
+    return zlib.crc32(str(key).encode("utf-8")) % n

@@ -23,6 +23,7 @@ from typing import Optional
 from ..ckpt import format as ckpt_fmt
 from ..ckpt.watch import CheckpointWatcher
 from ..obs import trace as _trace
+from .guardrail import REJECT, GuardrailEvaluator, GuardrailRejected
 from .stats import StreamingStats
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -42,15 +43,29 @@ class StreamingReloader:
     cadences the watcher usually polls *faster* than commits land, and
     the PR-6 skip logic plus the watcher's delivery lock keep every step
     adopted exactly once.
+
+    ``guard`` is an optional
+    :class:`~analytics_zoo_tpu.streaming.guardrail.GuardrailEvaluator`:
+    every commit is scored on its holdout window BEFORE adoption, and a
+    ``reject`` verdict raises through the watcher's rejected-step path —
+    the step is skipped forever (no ``stream.reload`` span ever opens for
+    it), the next commit is judged on its own merits, and the
+    ``guard_rejected`` counter ticks on this reloader's stats.
     """
 
     def __init__(self, model, root: str, *, poll_s: float = 1.0,
                  passphrase: Optional[str] = None,
                  start_at: Optional[int] = None,
-                 stats: Optional[StreamingStats] = None):
+                 stats: Optional[StreamingStats] = None,
+                 guard: Optional[GuardrailEvaluator] = None):
         self.model = model
         self.root = root
         self.stats = stats if stats is not None else StreamingStats()
+        self.guard = guard
+        if guard is not None:
+            # one counter surface: the guard's verdicts land on the same
+            # stats object the reloader exposes to the obs registry
+            guard.stats = self.stats
         if start_at is None:
             start_at = getattr(model, "_loaded_step", None)
         self.watcher = CheckpointWatcher(
@@ -61,7 +76,25 @@ class StreamingReloader:
     def _on_checkpoint(self, path: str, state, step: int):
         meta = ckpt_fmt.manifest_meta(path) if \
             ckpt_fmt.is_plane_dir(path) else {}
-        with _trace.span_under(meta.get("trace"), "stream.reload",
+        tok = meta.get("trace")
+        if self.guard is not None:
+            with _trace.span_under(tok, "stream.guard", step=step) as g:
+                verdict, score = self.guard.evaluate(state, step)
+                g.set(verdict=verdict,
+                      score=round(score, 6) if score is not None else None)
+            if verdict is REJECT:
+                # span-asserted contract: commit -> guard.reject, and NO
+                # stream.reload span ever opens for this step — the raise
+                # rides the watcher's rejected-step path (skip forever)
+                with _trace.span_under(tok, "guard.reject", step=step):
+                    logger.warning(
+                        "guardrail rejected streaming commit step %d "
+                        "(score=%.6g, baseline=%.6g): adoption skipped",
+                        step, score, self.guard.baseline())
+                raise GuardrailRejected(
+                    f"step {step} regressed on the holdout window "
+                    f"(score={score:.6g})")
+        with _trace.span_under(tok, "stream.reload",
                                step=step) as span:
             adopt = getattr(self.model, "apply_checkpoint", None)
             if adopt is None:               # bare callback consumers

@@ -40,7 +40,10 @@ class StreamingStats:
     _COUNTS = ("records_in", "records_trained", "records_deduped",
                "records_shed", "late_dropped", "late_included",
                "windows", "polls", "acks", "reloads",
-               "recompiles_after_warm")
+               "recompiles_after_warm",
+               # guardrail verdicts (guardrail.py): every commit scores
+               # exactly one of these before serving may adopt it
+               "guard_accepted", "guard_rejected", "guard_insufficient")
     _TIMES = ("ingest_s", "assemble_s", "train_s", "commit_s")
 
     def __init__(self, register: bool = True):

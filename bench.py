@@ -351,6 +351,344 @@ def bench_streaming(smoke: bool) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_streaming_fleet(smoke: bool) -> dict:
+    """Fleet-scale streaming bench — three legs over the real Redis
+    transport (bundled MiniRedisServer), asserting the scale-out story
+    end to end:
+
+    1. **freshness linearity** — the same aggregate record rate through 1
+       consumer and through 4 (keyed sub-streams, per-consumer window =
+       aggregate window / N): worst-consumer freshness p99 going 1 -> 4
+       must stay within 1.3x of the single-consumer p99 (the headline
+       ``value``; per-consumer windows shrink with N, so window fill time
+       — the freshness floor — is flat by design).
+    2. **guardrail reject** — a poisoned window's commit is scored on a
+       clean holdout, rejected, and NEVER adopted (no ``stream.reload``
+       span for that step, ``guard.reject`` chained under the commit's
+       trace), while a later clean commit is adopted on its own merits.
+    3. **SIGKILL replay** — one of two consumers is SIGKILLed
+       mid-stream; the supervisor respawns it onto its partition, the
+       PEL replays its unacked claims, and the partition's final
+       committed weights are byte-identical to an uninterrupted
+       reference run while the surviving consumer keeps progressing.
+    """
+    import functools
+    import tempfile
+    import threading
+
+    import jax
+
+    from analytics_zoo_tpu.ckpt import format as ckpt_fmt
+    from analytics_zoo_tpu.obs import trace as _trace
+    from analytics_zoo_tpu.serving.queue_api import make_broker
+    from analytics_zoo_tpu.serving.redis_protocol import MiniRedisServer
+    from analytics_zoo_tpu.streaming import (FleetReloaders,
+                                             GuardrailEvaluator,
+                                             StreamingFleet,
+                                             StreamingReloader,
+                                             StreamingTrainer,
+                                             StreamingXShards,
+                                             encode_record, partition_for,
+                                             seq_id)
+    from analytics_zoo_tpu.streaming.fleet import linear_estimator_factory
+    from analytics_zoo_tpu.streaming.guardrail import module_loss_scorer
+
+    BS, DIM = 16, 8
+    W_TRUE = (np.arange(DIM) / DIM).astype(np.float32)
+
+    class _Sink:
+        """Serving-model stand-in: records adopted steps."""
+        def __init__(self):
+            self.steps = []
+
+        def apply_checkpoint(self, path, state, step):
+            self.steps.append(int(step))
+
+    def _keys_by_partition(n, per):
+        """``per`` distinct keys per partition, so a round-robin producer
+        feeds every partition the same record count while still routing
+        through the real key hash."""
+        out = [[] for _ in range(n)]
+        j = 0
+        while any(len(o) < per for o in out):
+            k = f"user-{j}"
+            p = partition_for(k, n)
+            if len(out[p]) < per:
+                out[p].append(k)
+            j += 1
+        return out
+
+    # --- leg 1: freshness linearity at fixed aggregate rate ---------------
+    agg_window = 4 * BS                       # whole-fleet records per window
+    n_windows = 8 if smoke else 12            # per consumer
+    rate = 256.0 if smoke else 512.0          # aggregate records/s
+
+    def _freshness_run(n_consumers):
+        srv = MiniRedisServer(port=0).start()
+        root = tempfile.mkdtemp(prefix="zoo-fleetb-")
+        spec = f"redis://127.0.0.1:{srv.port}/fleetb?claim_idle_ms=500"
+        fleet = reloaders = None
+        stop_feed = threading.Event()
+        try:
+            fleet = StreamingFleet(
+                functools.partial(linear_estimator_factory, dim=DIM),
+                spec, root, consumers=n_consumers, batch_size=BS,
+                window_records=agg_window // n_consumers,
+                poll_timeout_s=0.05, idle_timeout_s=20.0, heartbeat_s=0.2)
+            reloaders = FleetReloaders(
+                {k: _Sink() for k in range(n_consumers)}, root,
+                poll_s=0.02).start()
+            prod = make_broker(f"{spec}&partitions={n_consumers}")
+            keys = _keys_by_partition(n_consumers, 16)
+            total = agg_window * n_windows
+            rng = np.random.default_rng(7)
+
+            def emit(i, paced_from=None):
+                p = i % n_consumers
+                x = rng.normal(size=DIM).astype(np.float32)
+                y = np.float32([x @ W_TRUE])
+                prod.enqueue(seq_id(i), encode_record(
+                    x, y, event_time=time.time(),
+                    key=keys[p][(i // n_consumers) % len(keys[p])]))
+
+            def feed():
+                period = 1.0 / rate
+                t_next = time.perf_counter()
+                for i in range(agg_window, agg_window + total):
+                    if stop_feed.is_set():
+                        return
+                    emit(i)
+                    t_next += period
+                    dt = t_next - time.perf_counter()
+                    if dt > 0:
+                        time.sleep(dt)
+
+            fleet.start()
+            if not fleet.wait_live(timeout_s=90):
+                raise RuntimeError("fleet consumers never went live")
+            # warm-up: one un-paced aggregate window pays every
+            # consumer's single window-1 compile BEFORE the measured
+            # feed — the 1.3x linearity bound is about steady state,
+            # not about N cold JITs racing each other for cores
+            for i in range(agg_window):
+                emit(i)
+            deadline = time.time() + 120.0
+            while time.time() < deadline and any(
+                    not r.freshness_samples
+                    for r in reloaders.reloaders.values()):
+                time.sleep(0.05)
+            warm = {k: len(r.freshness_samples)
+                    for k, r in reloaders.reloaders.items()}
+            feeder = threading.Thread(target=feed, name="fleet-producer",
+                                      daemon=True)
+            feeder.start()
+            if not fleet.join(timeout_s=240):
+                raise RuntimeError("fleet consumers never drained")
+            feeder.join(timeout=10)
+            m = fleet.stop()
+            # let the reloaders adopt the final commits
+            deadline = time.time() + 5.0
+            while time.time() < deadline and reloaders.poll_now():
+                time.sleep(0.02)
+            # worst-consumer p99 over the post-warm-up samples only
+            p99s = []
+            for k, r in reloaders.reloaders.items():
+                s = r.freshness_samples[warm[k]:] or r.freshness_samples
+                if s:
+                    p99s.append(float(np.percentile(s, 99)))
+            if not p99s:
+                raise RuntimeError("no freshness samples collected")
+            return max(p99s), m
+        finally:
+            stop_feed.set()
+            if reloaders is not None:
+                reloaders.stop()
+            if fleet is not None:
+                fleet.stop()
+            srv.stop()
+            shutil.rmtree(root, ignore_errors=True)
+
+    p99_1c, m_1c = _freshness_run(1)
+    p99_4c, m_4c = _freshness_run(4)
+    ratio = p99_4c / max(p99_1c, 1e-9)
+
+    # --- leg 2: guardrail reject (in-parent, span-asserted) ----------------
+    def _guard_leg():
+        srv = MiniRedisServer(port=0).start()
+        root = tempfile.mkdtemp(prefix="zoo-fleetg-")
+        est = None
+        try:
+            est = linear_estimator_factory(dim=DIM, lr=0.3)
+            prod = make_broker(f"redis://127.0.0.1:{srv.port}/guardb")
+            src = StreamingXShards(
+                f"redis://127.0.0.1:{srv.port}/guardb",
+                batch_size=BS, window_records=4 * BS, poll_timeout_s=0.05)
+            trainer = StreamingTrainer(est, src, root)
+            guard = GuardrailEvaluator(
+                module_loss_scorer(est.module), holdout_records=64,
+                min_holdout=32, regression=0.5, baseline_window=8)
+            rng = np.random.default_rng(11)
+            for _ in range(64):     # clean holdout the scorer judges on
+                x = rng.normal(size=DIM).astype(np.float32)
+                guard.observe(x, np.float32([x @ W_TRUE]))
+            sink = _Sink()
+            reloader = StreamingReloader(sink, root, poll_s=0.05,
+                                         start_at=-1, guard=guard)
+            seq = [0]
+
+            def feed_window(poison):
+                for _ in range(4 * BS):
+                    x = rng.normal(size=DIM).astype(np.float32)
+                    y = x @ W_TRUE + (10.0 if poison else 0.0)
+                    prod.enqueue(seq_id(seq[0]), encode_record(
+                        x, np.float32([y]), event_time=time.time()))
+                    seq[0] += 1
+
+            with _trace.tracing():
+                feed_window(poison=False)
+                trainer.run(max_windows=1, idle_timeout_s=10.0)
+                if not reloader.poll_now():
+                    raise RuntimeError("clean window was not adopted")
+                feed_window(poison=True)
+                trainer.run(max_windows=1, idle_timeout_s=10.0)
+                rejected_step = int(est.engine.step)
+                adopted_poison = reloader.poll_now()
+                # reject-then-later-accept: clean windows repair the
+                # weights; a LATER commit must adopt on its own merits
+                readopted = None
+                for _ in range(6):
+                    feed_window(poison=False)
+                    trainer.run(max_windows=1, idle_timeout_s=10.0)
+                    if reloader.poll_now():
+                        readopted = int(est.engine.step)
+                        break
+                spans = _trace.spans()
+            snap = reloader.stats.snapshot()
+            reject_spans = [s for s in spans if s.name == "guard.reject"]
+            reload_steps = [s.attrs.get("step") for s in spans
+                            if s.name == "stream.reload"]
+            return {
+                "rejected_step": rejected_step,
+                "rejected": int(snap.get("guard_rejected", 0)),
+                "accepted": int(snap.get("guard_accepted", 0)),
+                "readopted_step": readopted,
+                # the acceptance bar: the rejected commit is NEVER adopted
+                "rejected_never_adopted": bool(
+                    not adopted_poison
+                    and rejected_step not in sink.steps
+                    and rejected_step not in reload_steps),
+                "span_ok": bool(
+                    any(s.attrs.get("step") == rejected_step
+                        for s in reject_spans)
+                    and readopted is not None
+                    and readopted in reload_steps),
+            }
+        finally:
+            if est is not None:
+                est.shutdown()
+            srv.stop()
+            shutil.rmtree(root, ignore_errors=True)
+
+    guard_res = _guard_leg()
+
+    # --- leg 3: SIGKILL one consumer, PEL replay, bit-exact weights --------
+    chaos_windows = 4 if smoke else 8
+
+    def _chaos_run(kill):
+        srv = MiniRedisServer(port=0).start()
+        root = tempfile.mkdtemp(prefix="zoo-fleetc-")
+        spec = f"redis://127.0.0.1:{srv.port}/fleetc?claim_idle_ms=300"
+        fleet = None
+        try:
+            keys = _keys_by_partition(2, 4)
+            prod = make_broker(f"{spec}&partitions=2")
+            # the whole feed lands up front with FIXED event times: ref
+            # and chaos runs must consume byte-identical streams
+            i = 0
+            rng = np.random.default_rng(23)
+            for w in range(chaos_windows):
+                for p in (0, 1):
+                    for j in range(BS):
+                        x = rng.normal(size=DIM).astype(np.float32)
+                        y = np.float32([x @ W_TRUE])
+                        prod.enqueue(seq_id(i), encode_record(
+                            x, y, event_time=1.0e9 + i * 1e-3,
+                            key=keys[p][j % len(keys[p])]))
+                        i += 1
+            fleet = StreamingFleet(
+                functools.partial(linear_estimator_factory, dim=DIM),
+                spec, root, consumers=2, batch_size=BS, window_records=BS,
+                poll_timeout_s=0.05, idle_timeout_s=6.0, heartbeat_s=0.2)
+            fleet.start()
+            if kill:
+                # SIGKILL t0 right after its first commit lands: claimed-
+                # but-unacked records sit in partition 0's PEL and must
+                # replay through the respawned consumer
+                deadline = time.time() + 120
+                while time.time() < deadline and not \
+                        ckpt_fmt.loadable_step_dirs(fleet.partition_root(0)):
+                    time.sleep(0.01)
+                if not fleet.kill_consumer(0):
+                    raise RuntimeError("kill_consumer(0) found no live "
+                                       "consumer")
+            if not fleet.join(timeout_s=240):
+                raise RuntimeError("fleet consumers never drained")
+            m = fleet.stop()
+            final = {}
+            for p in (0, 1):
+                dirs = ckpt_fmt.loadable_step_dirs(fleet.partition_root(p))
+                step, path = dirs[-1]
+                state = ckpt_fmt.load_checkpoint_dir(path)
+                final[p] = (step, state["params"])
+            return m, final
+        finally:
+            if fleet is not None:
+                fleet.stop()
+            srv.stop()
+            shutil.rmtree(root, ignore_errors=True)
+
+    m_ref, final_ref = _chaos_run(kill=False)
+    m_chaos, final_chaos = _chaos_run(kill=True)
+
+    def _tree_identical(a, b):
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        return len(la) == len(lb) and all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(la, lb))
+
+    bit_identical = (final_ref[0][0] == final_chaos[0][0]
+                     and _tree_identical(final_ref[0][1], final_chaos[0][1]))
+    survivor_ok = (final_ref[1][0] == final_chaos[1][0]
+                   and _tree_identical(final_ref[1][1], final_chaos[1][1]))
+
+    return {
+        "metric": "fleet_freshness_p99_ratio",
+        "value": round(ratio, 3),
+        "unit": "x (worst-consumer p99, 4 consumers vs 1, fixed "
+                "aggregate rate)",
+        "vs_baseline": round(min(1.0, 1.3 / max(ratio, 1e-9)), 3),
+        "scale": {
+            "consumers": 4,
+            "freshness_p99_1c_s": round(p99_1c, 3),
+            "freshness_p99_4c_s": round(p99_4c, 3),
+            "ratio": round(ratio, 3),
+            "windows_1c": m_1c["windows_total"],
+            "windows_4c": m_4c["windows_total"],
+            "restarts": m_1c["restarts"] + m_4c["restarts"],
+        },
+        "guard": guard_res,
+        "chaos": {
+            "restarts": m_chaos["restarts"],
+            "reclaimed": m_chaos["reclaimed_total"],
+            "bit_identical": bool(bit_identical),
+            "survivor_ok": bool(survivor_ok),
+            "windows_ref": m_ref["windows_total"],
+            "windows_chaos": m_chaos["windows_total"],
+        },
+    }
+
+
 def bench_resnet50(smoke: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -2851,7 +3189,8 @@ def main():
                "infeed": bench_infeed, "ckpt": bench_ckpt,
                "comms": bench_comms, "sharding": bench_sharding,
                "resilience": bench_resilience,
-               "obs": bench_obs, "streaming": bench_streaming}
+               "obs": bench_obs, "streaming": bench_streaming,
+               "streaming_fleet": bench_streaming_fleet}
     # smoke runs must never clobber full-run artifacts (vs_baseline on a
     # reduced workload against a full-scale baseline is meaningless)
     detail_name = "BENCH_DETAIL_SMOKE.json" if smoke else "BENCH_DETAIL.json"
@@ -2900,7 +3239,8 @@ def main():
                       ("comms", "comms_collective_reduction"),
                       ("sharding", "sharding_model_over_chip"),
                       ("obs", "obs_disarmed_overhead"),
-                      ("streaming", "streaming_records_per_s")):
+                      ("streaming", "streaming_records_per_s"),
+                      ("streaming_fleet", "streaming_fleet")):
         r = detail.get(name, {})
         if r and "error" not in r:
             out[f"{key}_value"] = r["value"]

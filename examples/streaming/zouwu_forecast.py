@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Online Zouwu forecasting — a time-series model trained on the live
+stream, guarded by an online-eval gate, hot-reloaded into serving.
+
+The PR-19 streaming demo: a Zouwu :class:`LSTMForecaster` rides the
+whole streaming plane in one process tree against the bundled
+MiniRedisServer:
+
+* a **producer** thread XADDs sliding-window records from two synthetic
+  sensor series — each record carries its series id as the partition
+  **key** (``encode_record(key=...)``), the same wire format a
+  ``StreamingFleet`` shards by;
+* the **trainer** (StreamingXShards -> StreamingTrainer around
+  ``forecaster.estimator``) tails the stream into count windows, runs
+  incremental fit on each, and commits cursor-carrying checkpoints;
+* the **server** (InferenceModel + StreamingReloader) hot-swaps each
+  commit into the live forecaster with zero new compiles — but every
+  commit first passes an online **guardrail**: a
+  :class:`GuardrailEvaluator` scores it on a clean holdout window, and
+  when a mid-run *poisoned* window (labels offset by +0.5) regresses the
+  weights, that commit is REJECTED and never reaches serving; the next
+  clean commits repair the model and adoption resumes.
+
+Usage:
+    python examples/streaming/zouwu_forecast.py [--windows 6] [--smoke]
+"""
+
+import argparse
+import math
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+PAST = 16                   # lookback steps per record
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--window", type=int, default=64,
+                   help="records per training window")
+    p.add_argument("--windows", type=int, default=6,
+                   help="clean windows before the poisoned one")
+    p.add_argument("--rate", type=float, default=2000.0,
+                   help="producer records/s")
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.windows = 3
+
+    import jax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        InferenceModel
+    from analytics_zoo_tpu.serving import MiniRedisServer, RedisBroker
+    from analytics_zoo_tpu.streaming import (GuardrailEvaluator,
+                                             StreamingReloader,
+                                             StreamingTrainer,
+                                             StreamingXShards,
+                                             encode_record,
+                                             module_loss_scorer, seq_id)
+    from analytics_zoo_tpu.zouwu.model.forecast import LSTMForecaster
+
+    init_orca_context("local")
+
+    def series(sensor: int, t: int) -> float:
+        """Two phase-shifted noisy sines — the 'sensor fleet'."""
+        rng = np.random.RandomState(hash((sensor, t)) % (2 ** 31))
+        return math.sin(2 * math.pi * (t + 12 * sensor) / 24.0) \
+            + 0.05 * rng.randn()
+
+    def record_at(sensor: int, t: int, poison: float = 0.0):
+        x = np.array([[series(sensor, u)] for u in range(t - PAST, t)],
+                     np.float32)
+        y = np.float32([series(sensor, t) + poison])
+        return x, y
+
+    # --- transport: one embedded redis, keyed records -----------------------
+    srv = MiniRedisServer().start()
+    producer = RedisBroker(srv.host, srv.port, stream="zouwu",
+                           group="train")
+    seq = [0]
+    clock = {0: PAST, 1: PAST}          # per-sensor time pointer
+
+    def feed_window(poison: float = 0.0):
+        """One training window's worth of records, alternating sensors —
+        every record keyed by its series id (the fleet's shard key)."""
+        period = 1.0 / max(args.rate, 1e-6)
+        for _ in range(args.window):
+            sensor = seq[0] % 2
+            x, y = record_at(sensor, clock[sensor], poison)
+            clock[sensor] += 1
+            producer.enqueue(seq_id(seq[0]),
+                             encode_record(x, y, event_time=time.time(),
+                                           key=f"sensor-{sensor}"))
+            seq[0] += 1
+            time.sleep(period)
+
+    # --- trainer: the Zouwu forecaster's estimator on the stream ------------
+    model_dir = tempfile.mkdtemp(prefix="zouwu-stream-")
+    fc = LSTMForecaster(target_dim=1, feature_dim=1, lstm_units=(16, 8),
+                        lr=0.1)     # hot online lr: adapt within windows
+    source = StreamingXShards(
+        RedisBroker(srv.host, srv.port, stream="zouwu", group="train"),
+        batch_size=args.batch, window_records=args.window,
+        poll_timeout_s=0.05)
+    trainer = StreamingTrainer(fc.estimator, source, model_dir)
+
+    # --- guardrail: score every commit on a clean holdout -------------------
+    guard = GuardrailEvaluator(module_loss_scorer(fc.module),
+                               holdout_records=64, min_holdout=32,
+                               regression=1.0)
+    for t in range(PAST, PAST + 64):    # held-out clean windows
+        guard.observe(*record_at(0, t + 10_000))
+
+    # --- serving side: live model + guarded hot reload ----------------------
+    model = InferenceModel()
+    model.load_jax(fc.module, {"params": jax.device_get(fc.module.init(
+        jax.random.PRNGKey(0), np.zeros((1, PAST, 1), np.float32))
+        ["params"])})
+    probe = np.stack([record_at(0, PAST + 20_000 + t)[0]
+                      for t in range(8)])
+    truth = np.stack([record_at(0, PAST + 20_000 + t)[1]
+                      for t in range(8)])
+    model.predict(probe)                # warm the serving bucket
+    reloader = StreamingReloader(model, model_dir, poll_s=0.1,
+                                 start_at=-1, stats=source.stats,
+                                 guard=guard)
+
+    def report(tag):
+        pred = np.asarray(model.predict(probe)).reshape(truth.shape)
+        rmse = float(np.sqrt(np.mean((pred - truth) ** 2)))
+        snap = source.stats.snapshot()
+        print(f"[{tag}] probe_rmse={rmse:.3f} | "
+              f"windows={snap['windows']} reloads={snap['reloads']} "
+              f"guard(acc={snap.get('guard_accepted', 0)} "
+              f"rej={snap.get('guard_rejected', 0)}) "
+              f"freshness={snap.get('last_freshness_lag_s', '-')}s "
+              f"recompiles_after_warm={snap['recompiles_after_warm']}")
+
+    report("cold")
+    t0 = time.time()
+    for k in range(args.windows):
+        feeder = threading.Thread(target=feed_window, daemon=True,
+                                  name="producer")
+        feeder.start()
+        trainer.run(max_windows=1, idle_timeout_s=60.0)
+        feeder.join()
+        reloader.poll_now()             # deterministic adoption
+        report(f"window {k + 1}")
+
+    # --- the poisoned window: the guardrail must reject its commit ----------
+    print("\n-- poisoning one window (labels +0.5): the guardrail must "
+          "reject its commit --")
+    feed_window(poison=0.5)
+    trainer.run(max_windows=1, idle_timeout_s=60.0)
+    poisoned_step = int(fc.estimator.engine.step)
+    adopted = reloader.poll_now()
+    report("poisoned")
+    rejected = int(source.stats.snapshot().get("guard_rejected", 0))
+
+    # clean windows repair the weights; adoption resumes on merit
+    recovered = False
+    for k in range(6):
+        feed_window()
+        trainer.run(max_windows=1, idle_timeout_s=60.0)
+        if reloader.poll_now():
+            recovered = True
+            report(f"recovered (+{k + 1} clean windows)")
+            break
+        report(f"still rejected (+{k + 1} clean windows)")
+
+    wall = time.time() - t0
+    snap = source.stats.snapshot()
+    print(f"\ntrained {snap['records_trained']} records in {wall:.1f}s, "
+          f"{snap['reloads']} guarded hot reloads, "
+          f"{snap.get('guard_rejected', 0)} commit(s) rejected, "
+          f"{snap['recompiles_after_warm']} recompiles after warm window")
+    ok = (not adopted and rejected >= 1 and recovered
+          and reloader.stats.snapshot()["last_reload_step"]
+          != poisoned_step)
+    print("poisoned commit rejected and never served:", ok)
+
+    reloader.stop()
+    fc.estimator.shutdown()
+    srv.stop()
+    stop_orca_context()
+    if not ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,410 @@
+"""Fleet-scale streaming (analytics_zoo_tpu.streaming.fleet + the
+partitioned transport): deterministic key -> partition routing, the
+``?partition=``/``?partitions=`` broker surface with memory/file/redis
+parity, guardrail verdict/baseline semantics as a pure function of the
+score trace, the rejected-commit adoption contract (span-asserted), and
+the CheckpointWatcher's monotonic-adoption invariant under a
+multi-producer root.
+"""
+
+import os
+import uuid
+import zlib
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ckpt import CheckpointPlane, CheckpointWatcher
+from analytics_zoo_tpu.obs import trace
+from analytics_zoo_tpu.obs.registry import REGISTRY
+from analytics_zoo_tpu.serving.queue_api import (InMemoryBroker,
+                                                 PartitionedBroker,
+                                                 make_broker,
+                                                 partitioned_spec)
+from analytics_zoo_tpu.serving.redis_protocol import MiniRedisServer
+from analytics_zoo_tpu.streaming import (GuardrailEvaluator,
+                                         StreamingReloader, StreamingStats,
+                                         encode_record, partition_for,
+                                         record_key, seq_id)
+from analytics_zoo_tpu.streaming.guardrail import (ACCEPT, INSUFFICIENT,
+                                                   REJECT,
+                                                   module_loss_scorer)
+
+
+# --- key -> partition hash ---------------------------------------------------
+
+def test_partition_for_pinned_values():
+    """The mapping is part of the WIRE FORMAT: producers and consumers on
+    different hosts/restarts must agree, so the concrete CRC32 values are
+    pinned — a hash change is a breaking protocol change, not a refactor."""
+    assert zlib.crc32(b"sensor-0") == 540864325
+    assert partition_for("sensor-0", 4) == 1
+    assert partition_for("sensor-1", 4) == 3
+    assert partition_for("user:42", 4) == 2
+    assert partition_for("modelA", 8) == 1
+
+
+def test_partition_for_deterministic_disjoint_covering():
+    keys = [f"k{i}" for i in range(256)]
+    for n in (1, 2, 4, 8):
+        parts = [partition_for(k, n) for k in keys]
+        assert all(0 <= p < n for p in parts)
+        # deterministic: same key, same partition, every time
+        assert parts == [partition_for(k, n) for k in keys]
+        # covering: 256 keys land on every one of <= 8 partitions
+        assert set(parts) == set(range(n))
+
+
+def test_partition_for_rejects_nonpositive_n():
+    for n in (0, -1):
+        with pytest.raises(ValueError, match="n_partitions"):
+            partition_for("k", n)
+
+
+def test_record_key_roundtrip_header_only():
+    raw = encode_record(np.ones(3, np.float32), np.float32(1.0),
+                        event_time=5.0, key="sensor-7")
+    assert record_key(raw) == "sensor-7"
+    # keyless records carry no key — the router falls back to id hash
+    assert record_key(encode_record(np.ones(3, np.float32))) is None
+    with pytest.raises(ValueError, match="bad magic"):
+        record_key(b"JUNKxxxx")
+
+
+# --- the partitioned broker surface ------------------------------------------
+
+def test_partitioned_spec_narrows_and_keeps_params():
+    s = partitioned_spec("redis://h:1/s?claim_idle_ms=500", 3)
+    assert s == "redis://h:1/s?claim_idle_ms=500&partition=3"
+    # re-narrowing and fan-out params are stripped, not stacked
+    assert partitioned_spec(s, 1).count("partition=") == 1
+    assert "partitions=4" not in partitioned_spec(
+        "file:///d/q?partitions=4", 0)
+
+
+def _keyed(i, key):
+    return seq_id(i), encode_record(
+        np.full(4, float(i), np.float32), np.float32(i),
+        event_time=1e9 + i, key=key)
+
+
+def _route_and_claim(producer_spec, consumer_specs):
+    """Enqueue keyed records through the fan-out router, then claim each
+    shard through its consumer-side ``?partition=k`` handle."""
+    router = make_broker(producer_spec)
+    assert isinstance(router, PartitionedBroker)
+    n = router.n_partitions
+    keys = [f"sensor-{j}" for j in range(8)]
+    sent = {}
+    for i, key in enumerate(keys):
+        rid, payload = _keyed(i, key)
+        router.enqueue(rid, payload)
+        sent.setdefault(partition_for(key, n), set()).add(rid)
+    got = {}
+    for k, spec in enumerate(consumer_specs):
+        b = make_broker(spec)
+        batch = b.claim_batch(64, timeout_s=1.0)
+        got[k] = {rid for rid, _ in batch}
+        for rid, payload in batch:
+            # stream-order + key integrity across the shard boundary
+            assert partition_for(record_key(payload), n) == k
+        b.ack_many(got[k])
+    return sent, got
+
+
+def _assert_disjoint_covering(sent, got, n):
+    assert set().union(*got.values()) == set().union(*sent.values())
+    for a in range(n):
+        for b in range(a + 1, n):
+            assert not (got[a] & got[b])        # disjoint by construction
+        assert got.get(a, set()) == sent.get(a, set())
+
+
+def test_make_broker_partitions_memory():
+    name = f"fleet-{uuid.uuid4().hex[:8]}"
+    sent, got = _route_and_claim(
+        f"memory://{name}?partitions=2",
+        [f"memory://{name}?partition={k}" for k in range(2)])
+    _assert_disjoint_covering(sent, got, 2)
+    # sub-stream naming parity: memory shards are registry entries
+    assert f"{name}.p0" in InMemoryBroker._instances
+
+
+def test_make_broker_partitions_file(tmp_path):
+    sent, got = _route_and_claim(
+        f"file://{tmp_path}/q?partitions=2",
+        [f"file://{tmp_path}/q?partition={k}" for k in range(2)])
+    _assert_disjoint_covering(sent, got, 2)
+    assert (tmp_path / "q" / "p0").is_dir()     # <dir>/p<k> naming
+    assert (tmp_path / "q" / "p1").is_dir()
+
+
+def test_make_broker_partitions_redis():
+    srv = MiniRedisServer().start()
+    try:
+        base = f"redis://{srv.host}:{srv.port}/fleett"
+        sent, got = _route_and_claim(
+            base + "?partitions=2",
+            [base + f"?partition={k}" for k in range(2)])
+        _assert_disjoint_covering(sent, got, 2)
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("prefix,transport", [
+    ("memory://s", "memory"),
+    ("file:///tmp/does-not-matter/q", "file"),
+    ("redis://127.0.0.1:1/s", "redis"),     # parsed before any connect
+])
+def test_make_broker_partition_validation_names_transport(prefix,
+                                                          transport):
+    with pytest.raises(ValueError, match=f"{transport} broker.*not an "
+                                         "integer"):
+        make_broker(prefix + "?partition=x")
+    with pytest.raises(ValueError, match=f"{transport} broker.*must be "
+                                         ">= 1"):
+        make_broker(prefix + "?partitions=0")
+    with pytest.raises(ValueError, match=f"{transport} broker.*must be "
+                                         ">= 0"):
+        make_broker(prefix + "?partition=-1")
+    with pytest.raises(ValueError, match=f"{transport} broker.*mutually "
+                                         "exclusive"):
+        make_broker(prefix + "?partition=0&partitions=2")
+
+
+def test_partitioned_broker_keyless_id_routing_and_validation():
+    parts = [InMemoryBroker(), InMemoryBroker(), InMemoryBroker()]
+    pb = PartitionedBroker(parts, partition_by="key")
+    pb.enqueue("job-7", b"opaque payload")       # not a ZSR1 record
+    k = partition_for("job-7", 3)
+    assert parts[k].pending() == 1
+    assert sum(p.pending() for p in parts) == 1
+    # partition_by="id" ignores stamped keys entirely
+    pb2 = PartitionedBroker(
+        [InMemoryBroker(), InMemoryBroker()], partition_by="id")
+    rid, payload = _keyed(0, "sensor-0")
+    assert pb2.partition_of(rid, payload) == partition_for(rid, 2)
+    with pytest.raises(ValueError, match="partition_by"):
+        PartitionedBroker([InMemoryBroker()], partition_by="random")
+    with pytest.raises(ValueError, match=">= 1 partition"):
+        PartitionedBroker([])
+
+
+# --- guardrail: pure verdict semantics ---------------------------------------
+
+def test_guardrail_verdict_trace():
+    """The gate as a pure function of (score trace, holdout size) — no
+    model anywhere near this test."""
+    g = GuardrailEvaluator(holdout_records=8, min_holdout=4,
+                           regression=0.5, baseline_window=4)
+    # cold holdout: adopt-but-count, never block bootstrap
+    assert g.verdict(99.0, holdout_n=2) is INSUFFICIENT
+    assert g.baseline() is None                 # insufficient seeds nothing
+    # first scored commit seeds the baseline
+    assert g.verdict(1.0, holdout_n=8) is ACCEPT
+    assert g.baseline() == 1.0
+    # within regression tolerance: accept (1.2 <= 1.0 * 1.5)
+    assert g.verdict(1.2, holdout_n=8) is ACCEPT
+    assert g.baseline() == 1.0                  # min of accepted window
+    # past tolerance: reject, and the bad score must NOT ratchet the bar
+    assert g.verdict(1.6, holdout_n=8) is REJECT
+    assert g.baseline() == 1.0
+    # reject-then-later-accept: the next commit is judged on its merits
+    assert g.verdict(0.9, holdout_n=8) is ACCEPT
+    assert g.baseline() == 0.9
+    snap = g.stats.snapshot()
+    assert snap["guard_accepted"] == 3
+    assert snap["guard_rejected"] == 1
+    assert snap["guard_insufficient"] == 1
+    assert g.last_verdict is ACCEPT
+
+
+def test_guardrail_baseline_window_slides():
+    g = GuardrailEvaluator(holdout_records=4, min_holdout=1,
+                           regression=0.5, baseline_window=2)
+    for s in (1.0, 1.4, 1.4):
+        assert g.verdict(s, holdout_n=4) is ACCEPT
+    # the 1.0 aged out of the 2-accept window: the bar re-anchors
+    assert g.baseline() == 1.4
+    assert g.verdict(1.9, holdout_n=4) is ACCEPT    # 1.9 <= 1.4 * 1.5
+
+
+def test_guardrail_sizes_validated():
+    with pytest.raises(ValueError, match="guardrail sizes"):
+        GuardrailEvaluator(holdout_records=0)
+    with pytest.raises(ValueError, match="guardrail sizes"):
+        GuardrailEvaluator(min_holdout=0)
+    with pytest.raises(ValueError, match="guardrail sizes"):
+        GuardrailEvaluator(baseline_window=0)
+
+
+def test_guardrail_holdout_slides_and_skips_labelless():
+    g = GuardrailEvaluator(holdout_records=4, min_holdout=2)
+    for i in range(6):
+        g.observe(np.full(3, float(i), np.float32), np.float32(i))
+    assert g.holdout_size == 4                  # newest 4 only
+    xs, ys = g._stacked()
+    assert float(xs[0][0][0]) == 2.0            # oldest two slid out
+    g.observe_record(encode_record(np.ones(3, np.float32)))   # labelless
+    assert g.holdout_size == 4
+    g.observe_record(encode_record(np.ones(3, np.float32),
+                                   np.float32(7.0)))
+    assert float(ys[0][-1]) == 5.0 and g.holdout_size == 4
+
+
+def test_guardrail_evaluate_paths():
+    g = GuardrailEvaluator(holdout_records=4, min_holdout=2)
+    with pytest.raises(ValueError, match="needs a scorer"):
+        g.evaluate({"params": {}}, 1)
+    g.scorer = lambda state, xs, ys: 0.5
+    assert g.evaluate({"params": {}}, 1) == (INSUFFICIENT, None)
+    g.observe(np.ones(3, np.float32), np.float32(1.0))
+    g.observe(np.ones(3, np.float32), np.float32(2.0))
+    verdict, score = g.evaluate({"params": {}}, 2)
+    assert verdict is ACCEPT and score == 0.5
+
+
+def test_module_loss_scorer():
+    class Stub:
+        def apply(self, variables, x):
+            # "model" = first weight times first feature column
+            return x[:, 0] * variables["params"]["w"]
+
+    score = module_loss_scorer(Stub())
+    xs = (np.array([[2.0], [4.0]], np.float32),)
+    ys = (np.array([1.0, 2.0], np.float32),)
+    assert score({"params": {"w": 0.5}}, xs, ys) == 0.0
+    assert score({"params": {"w": 1.0}}, xs, ys) == pytest.approx(2.5)
+    with pytest.raises(ValueError, match="mse"):
+        module_loss_scorer(Stub(), loss="mae")
+
+
+def test_guardrail_counters_reach_obs_registry():
+    stats = StreamingStats()                    # registered collector
+    g = GuardrailEvaluator(holdout_records=4, min_holdout=1,
+                           regression=0.5, stats=stats)
+    assert g.verdict(1.0, holdout_n=4) is ACCEPT
+    assert g.verdict(9.0, holdout_n=4) is REJECT
+    samples = {name: v for name, _labels, v in REGISTRY.collector_samples()
+               if name.startswith("zoo_streaming_guard")}
+    assert samples.get("zoo_streaming_guard_accepted") == 1
+    assert samples.get("zoo_streaming_guard_rejected") == 1
+
+
+# --- the adoption contract: rejected commits never reach serving -------------
+
+def _state(step):
+    rng = np.random.RandomState(step)
+    return {"params": {"w": rng.rand(4, 2).astype(np.float32)},
+            "step": step}
+
+
+class _Sink:
+    def __init__(self):
+        self.steps = []
+
+    def apply_checkpoint(self, path, state, step):
+        self.steps.append(int(step))
+
+
+def test_reloader_guard_rejects_commit_and_recovers(tmp_path):
+    """Span-asserted acceptance shape: commit -> ``guard.reject``, NO
+    ``stream.reload`` span ever opens for the rejected step, the step is
+    never re-scored (skip-forever), and the next clean commit adopts."""
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    scores = {1: 1.0, 2: 9.9, 3: 1.01}
+    guard = GuardrailEvaluator(
+        lambda state, xs, ys: scores[int(state["step"])],
+        holdout_records=4, min_holdout=2, regression=0.5)
+    for i in range(2):
+        guard.observe(np.ones(3, np.float32), np.float32(i))
+    sink = _Sink()
+    rel = StreamingReloader(sink, str(tmp_path), poll_s=60, start_at=-1,
+                            guard=guard)
+    with trace.tracing(capacity=1024) as ring:
+        plane.save(_state(1), 1)
+        assert rel.poll_now()                   # clean commit adopts
+        plane.save(_state(2), 2)
+        assert not rel.poll_now()               # regressed commit: rejected
+        assert not rel.poll_now()               # ...and not re-scored
+        plane.save(_state(3), 3)
+        assert rel.poll_now()                   # recovery on merit
+    assert sink.steps == [1, 3]
+    snap = rel.stats.snapshot()
+    assert snap["guard_rejected"] == 1
+    assert snap["guard_accepted"] == 2
+    assert snap["reloads"] == 2 and snap["last_reload_step"] == 3
+    by_name = {}
+    for s in ring.spans():
+        by_name.setdefault(s.name, []).append(s)
+    assert [s.attrs["step"] for s in by_name["guard.reject"]] == [2]
+    reload_steps = [s.attrs["step"] for s in by_name["stream.reload"]]
+    assert 2 not in reload_steps and reload_steps == [1, 3]
+    # every delivered commit was scored exactly once
+    assert sorted(s.attrs["step"] for s in by_name["stream.guard"]) \
+        == [1, 2, 3]
+    plane.close()
+
+
+def test_fleet_reloaders_per_partition_adoption(tmp_path):
+    from analytics_zoo_tpu.streaming import FleetReloaders
+
+    for k in (0, 1):
+        plane = CheckpointPlane(str(tmp_path / f"p{k}"), async_save=False)
+        plane.save(_state(k + 1), k + 1)
+        plane.close()
+    sinks = {0: _Sink(), 1: _Sink()}
+    fr = FleetReloaders(sinks, str(tmp_path), poll_s=60, start_at=-1)
+    try:
+        assert fr.poll_now() == 2               # each shard adopts its own
+        assert sinks[0].steps == [1] and sinks[1].steps == [2]
+        assert fr.poll_now() == 0               # nothing newer anywhere
+        snap = fr.snapshot()
+        assert snap[0]["last_reload_step"] == 1
+        assert snap[1]["last_reload_step"] == 2
+    finally:
+        fr.stop()
+
+
+def test_streaming_fleet_constructor_contracts(tmp_path):
+    from analytics_zoo_tpu.streaming import StreamingFleet
+    from analytics_zoo_tpu.streaming.fleet import linear_estimator_factory
+
+    with pytest.raises(ValueError, match="memory://"):
+        StreamingFleet(linear_estimator_factory, "memory://s",
+                       str(tmp_path))
+    with pytest.raises(ValueError, match="consumers"):
+        StreamingFleet(linear_estimator_factory,
+                       f"file://{tmp_path}/q", str(tmp_path), consumers=0)
+    fleet = StreamingFleet(linear_estimator_factory,
+                           f"file://{tmp_path}/q", str(tmp_path),
+                           consumers=2)
+    assert fleet.partition_root(1) == str(tmp_path / "p1")
+    assert fleet.router.n_partitions == 2
+    assert fleet.alive() == 0                   # never started: no procs
+
+
+# --- watcher: monotonic adoption under a multi-producer root -----------------
+
+def test_watcher_never_adopts_older_step_with_newer_mtime(tmp_path):
+    """Fleet-scale regression: a lagging producer (a respawned trainer
+    re-committing while its peers race ahead) writes an OLD step with the
+    NEWEST directory mtime. Adopting it would roll live serving
+    backwards — selection must order by step number, never by mtime."""
+    plane = CheckpointPlane(str(tmp_path), async_save=False)
+    seen = []
+    w = CheckpointWatcher(str(tmp_path),
+                          lambda p, st, step: seen.append(step), poll_s=60)
+    plane.save(_state(3), 3)
+    assert w.poll_now() and seen == [3]
+    # the laggard: step 2 lands AFTER step 3, with a far-newer mtime
+    lagging = CheckpointPlane(str(tmp_path), async_save=False)
+    lagging.save(_state(2), 2)
+    future = 2 ** 31
+    os.utime(tmp_path / "ckpt-2", (future, future))
+    assert not w.poll_now() and seen == [3]     # stale step never delivered
+    assert w.last_step == 3
+    plane.save(_state(4), 4)
+    assert w.poll_now() and seen == [3, 4]
+    plane.close()
+    lagging.close()
