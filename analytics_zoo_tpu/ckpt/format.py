@@ -142,9 +142,18 @@ def leaf_record(arr: np.ndarray, digest: str) -> Dict[str, Any]:
             "shape": list(arr.shape), "nbytes": int(arr.nbytes)}
 
 
-def decode_leaf(raw: bytes, rec: Dict[str, Any]) -> np.ndarray:
+def decode_leaf(raw, rec: Dict[str, Any],
+                writable: bool = True) -> np.ndarray:
     if digest_of(raw) != rec["digest"]:
         raise ValueError(f"blob {rec['digest'][:12]} checksum mismatch")
+    if not writable:
+        # zero-copy view straight over ``raw`` (a mapped blob on the
+        # hot-reload path): the adopting engine only reads — predict
+        # feeds the leaves to XLA, which copies at device transfer
+        arr = np.frombuffer(raw, dtype=_np_dtype(rec["dtype"]))
+        arr = arr.reshape(tuple(rec["shape"]))
+        arr.flags.writeable = False
+        return arr
     # frombuffer over a bytearray copy: bytes-backed views are READ-ONLY,
     # and the pickle path this format replaces returned writable arrays —
     # fit_eval state consumers may update restored leaves in place
@@ -261,12 +270,21 @@ def manifest_meta(ckpt_dir: str) -> Dict:
     return read_manifest(ckpt_dir).get("meta", {}) or {}
 
 
-def load_checkpoint_dir(ckpt_dir: str, passphrase: Optional[str] = None):
+def load_checkpoint_dir(ckpt_dir: str, passphrase: Optional[str] = None,
+                        map_blobs: bool = False):
     """Read one checkpoint directory back into its state pytree.
 
     Handles both formats: a checkpoint-plane dir (manifest + blobs,
     digest-verified leaf by leaf) and a legacy ``state.pkl`` dir — old
     checkpoints written by the pickle path stay readable forever.
+
+    ``map_blobs=True`` (the hot-reload path) mmaps each unencrypted leaf
+    blob instead of reading it into a heap copy: leaves come back as
+    READ-ONLY views over the page cache, so N adopting processes share
+    one physical copy and adoption never doubles the model's host RSS.
+    Training restore keeps the default (writable copies) — state
+    consumers may update restored leaves in place. Encrypted checkpoints
+    always copy (decrypt-to-heap).
     """
     from .store import BlobStore
 
@@ -290,8 +308,14 @@ def load_checkpoint_dir(ckpt_dir: str, passphrase: Optional[str] = None):
                     passphrase=passphrase)
     if digest_of(raw) != sk["digest"]:
         raise ValueError(f"{ckpt_dir}: skeleton blob checksum mismatch")
-    leaves = [decode_leaf(
-        store.get(rec["digest"], encrypted=doc["encrypted"],
-                  passphrase=passphrase), rec)
-        for rec in doc["leaves"]]
+    mapped = bool(map_blobs) and not doc["encrypted"]
+    if mapped:
+        leaves = [decode_leaf(store.map(rec["digest"]), rec,
+                              writable=False)
+                  for rec in doc["leaves"]]
+    else:
+        leaves = [decode_leaf(
+            store.get(rec["digest"], encrypted=doc["encrypted"],
+                      passphrase=passphrase), rec)
+            for rec in doc["leaves"]]
     return join_state(raw, leaves)

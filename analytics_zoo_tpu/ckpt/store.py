@@ -81,6 +81,18 @@ class BlobStore:
             raw = decrypt_bytes(raw, passphrase)
         return raw
 
+    def map(self, digest: str):
+        """Map an UNENCRYPTED blob read-only (mmap) instead of reading it
+        into a heap copy — the hot-reload path decodes leaves straight
+        over the page cache, so N serving processes adopting the same
+        checkpoint share one physical copy. The mapping stays valid while
+        any view holds it (numpy keeps the mmap object referenced);
+        content-addressed blobs are never rewritten in place, so a mapped
+        view cannot change under the reader."""
+        import mmap
+        with open(self.path(digest, False), "rb") as f:
+            return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+
     # --- GC -----------------------------------------------------------------
     def _live_names(self, root: str) -> Set[str]:
         """Every blob filename referenced by any manifest under ``root``

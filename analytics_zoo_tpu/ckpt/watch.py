@@ -85,7 +85,13 @@ class CheckpointWatcher:
             # while a poll is in flight)
             return False
         try:
-            state = fmt.load_checkpoint_dir(path, self.passphrase)
+            # map_blobs: the adopting engine only READS the state (predict
+            # copies at device transfer), so leaves come back as read-only
+            # mmap views over the page cache — N watchers adopting the
+            # same step share one physical copy instead of each re-reading
+            # every blob onto its heap
+            state = fmt.load_checkpoint_dir(path, self.passphrase,
+                                            map_blobs=True)
         except Exception as e:      # noqa: BLE001 — retry next poll
             logger.warning("hot-reload: checkpoint %s unreadable (%s: %s); "
                            "will retry", path, type(e).__name__, e)

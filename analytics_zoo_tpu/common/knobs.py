@@ -233,6 +233,25 @@ _KNOBS = [
        "Accepted-commit scores retained for the guardrail baseline "
        "(best-of window; rejected scores never enter it, so one bad "
        "window cannot ratchet the bar down)."),
+    # --- shm object plane ---------------------------------------------------
+    _k("ZOO_SHM", "bool", False, "shm",
+       "Zero-copy shared-memory object plane: broker messages on local "
+       "transports (memory/file, plus redis on localhost) carry slab "
+       "descriptors instead of payload bytes; consumers map the slab "
+       "read-only. 0 = today's inline wire, byte for byte."),
+    _k("ZOO_SHM_SLAB_MB", "float", 1.0, "shm",
+       "Slab granularity of the shared-memory arena (allocation unit; an "
+       "object takes a contiguous run of slabs). Size it near the typical "
+       "payload: much larger wastes arena, much smaller fragments it."),
+    _k("ZOO_SHM_ARENA_MB", "int", 64, "shm",
+       "Bytes per shared-memory segment; the arena grows segment by "
+       "segment on demand (bounded), and payloads that do not fit fall "
+       "back to the inline wire."),
+    _k("ZOO_SHM_MIN_BYTES", "int", 65536, "shm",
+       "Payloads smaller than this ride the inline wire even with "
+       "ZOO_SHM=1: below it the descriptor overhead (slab burn, index "
+       "lock, lease writes) exceeds the copy savings. 0 = every payload "
+       "takes the descriptor path."),
     # --- multihost ----------------------------------------------------------
     _k("ZOO_COORDINATOR", "str", None, "multihost",
        "host:port of the jax.distributed coordinator for multi-process "

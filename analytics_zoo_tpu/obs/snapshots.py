@@ -4,7 +4,7 @@
 Each function runs a tiny CPU workload through the real production path of
 one plane and prints a single ``NAME=<json>`` line (``TRANSFER_PLANE=``,
 ``CKPT_PLANE=``, ``COMMS_PLANE=``, ``SHARDING_PLANE=``, ``RESILIENCE=``,
-``ANALYSIS=``, ``OBS=``). These used to live as five bespoke ``python - <<EOF`` heredocs
+``SHM=``, ``ANALYSIS=``, ``OBS=``). These used to live as five bespoke ``python - <<EOF`` heredocs
 inside run_tier1.sh; the script now loops over
 ``python -m analytics_zoo_tpu.obs snapshot <plane>`` so the
 snapshot logic is importable, testable and shared with the CLI.
@@ -450,6 +450,67 @@ def snapshot_fleet() -> int:
         "restarts": snap["restarts"]})
 
 
+def snapshot_shm() -> int:
+    """The shared-memory object plane end to end: descriptor frames for a
+    handful of serving-codec tensors through a FileBroker spool with
+    ``ZOO_SHM=1`` — one slab copy per request, zero-copy consumer
+    mappings, inline-fallback accounting, and a clean drain (0 live
+    allocations after every ``done``)."""
+    import numpy as np
+
+    from .. import shm
+    from ..serving.codecs import decode_ref, encode_payload_ref
+    from ..serving.queue_api import make_broker
+
+    prev = os.environ.get("ZOO_SHM")
+    os.environ["ZOO_SHM"] = "1"
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            spec = f"file://{d}/shm"
+            arena = shm.arena_for_spec(spec)
+            if arena is None:
+                return _emit("SHM", {"enabled": False})
+            broker = make_broker(spec)
+            rng = np.random.RandomState(0)
+            n, descriptor, zero_copy = 8, 0, 0
+            try:
+                for i in range(n):
+                    # 128 KB tensors: comfortably over the ZOO_SHM_MIN_BYTES
+                    # floor, so every frame takes the descriptor path
+                    x = rng.rand(32768).astype(np.float32)
+                    frame, _ = encode_payload_ref(x, arena=arena)
+                    descriptor += shm.is_envelope(frame)
+                    broker.enqueue(f"s{i}", frame)
+                    (rid, raw), = broker.claim_batch(1, 5.0)
+                    data, _meta, refs = decode_ref(raw, arena=arena)
+                    view = np.asarray(data)
+                    zero_copy += (view.base is not None
+                                  and not view.flags.writeable)
+                    ok = bool(np.array_equal(view, x))
+                    del data, view
+                    broker.ack(rid)
+                    for r in refs:
+                        arena.done(r)
+                    if not ok:
+                        return _emit("SHM", {"error": "roundtrip mismatch"})
+                stats = arena.stats()
+                swept = arena.sweep()
+                return _emit("SHM", {
+                    "enabled": True, "requests": n,
+                    "descriptor_frames": int(descriptor),
+                    "zero_copy_mappings": int(zero_copy),
+                    "allocs_live_after_drain": stats["allocs_live"],
+                    "segments": stats["segments"],
+                    "leases_swept": swept["leases_swept"]})
+            finally:
+                arena.destroy()
+    finally:
+        if prev is None:
+            os.environ.pop("ZOO_SHM", None)
+        else:
+            os.environ["ZOO_SHM"] = prev
+
+
 def snapshot_analysis() -> int:
     """Repo lint findings, golden program-contract drift, and the HLO
     linter's hook report from a bucketed comms fit on the simulated
@@ -742,7 +803,7 @@ PLANES = {"transfer": snapshot_transfer, "ckpt": snapshot_ckpt,
           "comms": snapshot_comms, "sharding": snapshot_sharding,
           "resilience": snapshot_resilience,
           "serving": snapshot_serving, "fleet": snapshot_fleet,
-          "streaming": snapshot_streaming,
+          "streaming": snapshot_streaming, "shm": snapshot_shm,
           "analysis": snapshot_analysis, "obs": snapshot_obs}
 
 

@@ -125,7 +125,8 @@ def encode_payload(data: Any, meta: Dict | None = None) -> bytes:
 
 
 def decode_payload(raw: bytes) -> Tuple[Any, Dict]:
-    body = json.loads(raw.decode("utf-8"))
+    body = json.loads(raw.decode("utf-8") if isinstance(
+        raw, (bytes, bytearray)) else bytes(raw).decode("utf-8"))
     kind = body["kind"]
     if kind in ("tensor", "sparse"):
         data = _decode_one(body)
@@ -134,3 +135,101 @@ def decode_payload(raw: bytes) -> Tuple[Any, Dict]:
     else:
         data = {k: _decode_one(v) for k, v in body["data"].items()}
     return data, body.get("meta", {})
+
+
+# --- shm descriptor wire ----------------------------------------------------
+# The JSON + base64(arrow) wire above costs ~2.7 copies of every tensor on
+# each side (contiguous copy, arrow buffer, b64 text). On a shm-enabled
+# stream the producer instead writes RAW tensor bytes into arena slabs once
+# and ships descriptors (dtype/shape ride the ObjectRef); the consumer maps
+# them read-only — zero payload copies on decode. Sparse tensors and any
+# arena failure fall back to an inline frame wrapping the exact legacy
+# encoding, so mixed traffic drains through one decode entry point.
+
+def encode_payload_ref(data: Any, meta: Dict | None = None, *,
+                       arena) -> Tuple[bytes, List]:
+    """Encode for a shm-enabled stream: ``(wire_bytes, refs)``.
+
+    Dense payloads (ndarray | list/tuple | dict[str, ndarray]) go to
+    slabs — one descriptor per tensor, layout + user meta in the envelope
+    header. The producer pin is released before returning (the frame is
+    self-contained); consumers owe ``arena.done(ref)`` per ref after the
+    result is published. Sparse payloads and arena overflow return an
+    inline frame of :func:`encode_payload` with ``refs == []``; with no
+    arena at all this IS :func:`encode_payload`."""
+    from ..shm import ArenaFull, min_shm_bytes, wrap_inline, wrap_ref
+    if arena is None:
+        return encode_payload(data, meta), []
+    names: List[str] | None = None
+    if isinstance(data, np.ndarray):
+        kind, arrays = "tensor", [data]
+    elif isinstance(data, (list, tuple)) and data and all(
+            not isinstance(a, SparseTensor) for a in data):
+        kind, arrays = "tensors", [np.asarray(a) for a in data]
+    elif isinstance(data, dict) and data and all(
+            not isinstance(v, SparseTensor) for v in data.values()):
+        kind = "named"
+        names = [str(k) for k in data.keys()]
+        arrays = [np.asarray(data[k]) for k in data.keys()]
+    else:
+        return wrap_inline(encode_payload(data, meta)), []
+    if sum(int(np.asarray(a).nbytes) for a in arrays) < min_shm_bytes():
+        # under the size floor the descriptor overhead (slab burn, index
+        # lock, lease writes) costs more than the copy it saves — stay on
+        # the legacy wire, byte for byte
+        return encode_payload(data, meta), []
+    refs = []
+    try:
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            refs.append(arena.put(a, dtype=a.dtype.str, shape=a.shape))
+    except (ArenaFull, OSError, ValueError):
+        for r in refs:          # free the partial put — inline carries all
+            arena.done(r)
+        return wrap_inline(encode_payload(data, meta)), []
+    env_meta: Dict = {}
+    if names is not None:
+        env_meta["names"] = names
+    if meta:
+        env_meta["meta"] = meta
+    frame = wrap_ref(refs, meta=env_meta or None, kind=kind)
+    for r in refs:              # handoff complete: drop the producer pins
+        arena.release(r)
+    return frame, refs
+
+
+def decode_ref(raw, *, arena=None) -> Tuple[Any, Dict, List]:
+    """Decode a serving payload that may be a shm envelope: returns
+    ``(data, meta, refs)``. Descriptor frames map each tensor's slab
+    read-only (zero copy, C-contiguous, pinned in this process's lease)
+    and the caller owes ``arena.done(ref)`` per ref strictly AFTER the
+    answer for the item is published — a PEL reclaim must be able to
+    re-resolve the same generation. Inline frames and legacy payloads
+    decode exactly as :func:`decode_payload` with ``refs == []``."""
+    from ..shm import ObjectRef, is_envelope, unwrap
+    if not is_envelope(raw):
+        return (*decode_payload(raw), [])
+    flag, header, payload = unwrap(raw)
+    if flag == "I":
+        return (*decode_payload(payload), [])
+    if arena is None:
+        raise ValueError("descriptor frame on a stream with no shm arena "
+                         "(consumer has ZOO_SHM off or shm unavailable)")
+    refs = [ObjectRef.from_dict(d) for d in header.get("refs", [])]
+    arrays = []
+    try:
+        for r in refs:
+            arrays.append(arena.checkout(r))
+    except Exception:
+        for r, _ in zip(refs, arrays):   # unwind partial pins
+            arena.release(r)
+        raise
+    env_meta = header.get("meta") or {}
+    kind = header.get("kind", "tensors")
+    if kind == "tensor":
+        data: Any = arrays[0]
+    elif kind == "named":
+        data = dict(zip(env_meta.get("names", []), arrays))
+    else:
+        data = list(arrays)
+    return data, env_meta.get("meta", {}), refs

@@ -33,7 +33,9 @@ from ..obs import trace as _trace
 from ..obs.registry import REGISTRY, InstancedEvents
 from ..resilience import faults as _faults
 from ..resilience.stats import STATS
-from .codecs import decode_payload, densify, encode_payload
+from ..shm import arena_for_spec as _shm_arena_for_spec
+from ..shm import peek_refs as _shm_peek_refs
+from .codecs import decode_payload, decode_ref, densify, encode_payload
 from .queue_api import Broker, make_broker
 from .scheduler import ContinuousScheduler, ModelMultiplexer, ServingRequest
 
@@ -117,6 +119,13 @@ class ClusterServing:
                              "before constructing ClusterServing")
         self.broker: Broker = make_broker(queue) if isinstance(queue, str) \
             else queue
+        # shm object plane: on a local, ZOO_SHM-enabled stream request
+        # payloads may arrive as descriptor frames — map them from the
+        # spec-derived arena every sibling process agrees on (None keeps
+        # today's inline wire, byte for byte)
+        self._arena = _shm_arena_for_spec(
+            queue if isinstance(queue, str)
+            else getattr(self.broker, "spec", None))
         self.batch_size = int(knobs.get("ZOO_SERVING_BATCH_SIZE")
                               if batch_size is None else batch_size)
         self.batch_timeout = float(
@@ -272,6 +281,20 @@ class ClusterServing:
         finally:
             self.sched.finish_input()
 
+    def _refs_done(self, refs):
+        """Mark slab descriptors consumed — called strictly AFTER the
+        item's answer was published (put_result is serving's ack): a PEL
+        reclaim of an unanswered item must re-resolve the same
+        generation."""
+        if not refs or self._arena is None:
+            return
+        for r in refs:
+            try:
+                self._arena.done(r)
+            except Exception as e:  # noqa: BLE001 — freeing must not
+                # fail serving; a sweep/gc reclaims whatever this missed
+                logger.warning("shm done failed for %s: %s", r, e)
+
     def _route_claim(self, batch):
         """Decode + shed + route one claimed batch. Every claimed item gets
         a result — error payloads for shed/failed ones — so frontend fetches
@@ -288,6 +311,7 @@ class ClusterServing:
             # in-memory one would hang the client to its timeout
             self.broker.put_result(req.item_id, encode_payload(
                 np.zeros(0), meta={"error": "serving stopped"}))
+            self._refs_done(req.shm_refs)
 
     def _decode_and_shed(self, batch):
         """Per-item decode (one malformed record fails itself, not its
@@ -302,15 +326,18 @@ class ClusterServing:
         span-vs-result race the streaming-cadence tests caught); the token
         is the first decoded item's (shed included)."""
         reqs: List[ServingRequest] = []
-        shed: List[Tuple[str, bytes]] = []
+        shed: List[Tuple[str, bytes, tuple]] = []
         batch_tok = None
         default_model = self.mux.default_name
         with self.timer.time("decode"):
             _faults.fire("serving.decode")  # chaos hook (whole batch)
             now = time.time()
             for item_id, payload in batch:
+                refs: tuple = ()
                 try:
-                    data, meta = decode_payload(payload)
+                    data, meta, item_refs = decode_ref(
+                        payload, arena=self._arena)
+                    refs = tuple(item_refs)
                     if batch_tok is None:
                         batch_tok = meta.get("trace")
                     # deadline parse is per-item too: a client that sends
@@ -323,6 +350,7 @@ class ClusterServing:
                     self._count("decode_errors")
                     self.broker.put_result(item_id, encode_payload(
                         np.zeros(0), meta={"error": f"bad payload: {e}"}))
+                    self._refs_done(refs)
                     continue
                 if expired:
                     self._count("shed_expired")
@@ -330,7 +358,7 @@ class ClusterServing:
                     shed.append((item_id, encode_payload(
                         np.zeros(0),
                         meta={"error": "deadline exceeded",
-                              "shed": "expired"})))
+                              "shed": "expired"}), refs))
                     continue
                 model = meta.get("model") or default_model
                 if model not in self.mux:
@@ -339,6 +367,7 @@ class ClusterServing:
                         np.zeros(0), meta={
                             "error": f"unknown model {model!r} (serving: "
                                      f"{sorted(self.mux.names())})"}))
+                    self._refs_done(refs)
                     continue
                 # sparse ingress (reference: http/domains.scala:100)
                 # densifies at admission — the TPU executable wants static
@@ -347,16 +376,19 @@ class ClusterServing:
                 # itself, not its batchmates
                 try:
                     reqs.append(ServingRequest(item_id, densify(data),
-                                               meta, model))
+                                               meta, model,
+                                               shm_refs=refs))
                 except Exception as e:      # noqa: BLE001 — bad record
                     self._count("decode_errors")
                     self.broker.put_result(item_id, encode_payload(
                         np.zeros(0), meta={"error": f"bad payload: {e}"}))
+                    self._refs_done(refs)
         return reqs, shed, batch_tok
 
     def _publish_shed(self, shed):
-        for item_id, payload in shed:
+        for item_id, payload, refs in shed:
             self.broker.put_result(item_id, payload)
+            self._refs_done(refs)
 
     def _decode_prologue(self, batch):
         """The shared claim prologue for BOTH claim paths (continuous
@@ -375,9 +407,16 @@ class ClusterServing:
             self.mux.default.breaker.record_failure()
             self._count("batch_failures")
             logger.exception("serving decode stage failed: %s", e)
-            for item_id, _ in batch:
+            for item_id, payload in batch:
                 self.broker.put_result(item_id, encode_payload(
                     np.zeros(0), meta={"error": str(e)}))
+                # the per-item refs were lost with the stage: peek the
+                # descriptors off the raw payload (no checkout) so the
+                # answered items' slabs still free
+                try:
+                    self._refs_done(_shm_peek_refs(payload))
+                except Exception as pe:  # noqa: BLE001 — malformed frame
+                    logger.warning("shm peek failed: %s", pe)
             return None
         _trace.record_span("serving.decode", t_dec, time.perf_counter(),
                            parent=batch_tok, n=len(batch))
@@ -432,6 +471,7 @@ class ClusterServing:
                 self.broker.put_result(r.item_id, encode_payload(
                     np.zeros(0), meta={"error": "deadline exceeded",
                                        "shed": "expired"}))
+                self._refs_done(r.shm_refs)
             else:
                 live.append(r)
         if not live:
@@ -451,6 +491,7 @@ class ClusterServing:
                 self.broker.put_result(r.item_id, encode_payload(
                     np.zeros(0), meta={"error": "circuit open",
                                        "shed": "circuit_open"}))
+                self._refs_done(r.shm_refs)
             return
         try:
             self._process(entry, live, batch_tok)
@@ -463,6 +504,7 @@ class ClusterServing:
             for r in live:
                 self.broker.put_result(r.item_id, encode_payload(
                     np.zeros(0), meta={"error": str(e)}))
+                self._refs_done(r.shm_refs)
 
     def _process(self, entry, live, batch_tok=None):
         arrays = [r.data for r in live]
@@ -512,6 +554,7 @@ class ClusterServing:
                 # completion time, independent of their fetch scheduling
                 self.broker.put_result(r.item_id, encode_payload(
                     out, meta={"t_done": done_t}))
+                self._refs_done(r.shm_refs)
         self.records_out += len(live)
         entry.records_out += len(live)
         entry.batches += 1

@@ -47,6 +47,7 @@ import numpy as np
 from ..common import knobs
 from ..obs import trace as _trace
 from ..obs.registry import REGISTRY, InstancedEvents
+from ..shm import sweep_spec as _shm_sweep_spec
 from .queue_api import make_broker
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -376,11 +377,14 @@ class ServingFleet:
         with self._lock:
             # 1. reap: a retiring worker leaving is the plan; anything
             # else died under us and the reconcile below respawns it
+            dead_pids: List[int] = []
             for wid, p in list(self._procs.items()):
                 if p.is_alive():
                     continue
                 p.join(timeout=0)
                 del self._procs[wid]
+                if p.pid is not None:
+                    dead_pids.append(p.pid)
                 if wid in self._retiring:
                     self._retiring.discard(wid)
                 else:
@@ -388,6 +392,16 @@ class ServingFleet:
                     logger.warning(
                         "fleet: worker %s died (exitcode=%s) — respawning",
                         wid, p.exitcode)
+            if dead_pids:
+                # shm object plane: a SIGKILLed worker's slab pins die with
+                # its pid — sweep its lease files so nothing leaks (unacked
+                # entries replay and re-resolve their still-live blobs)
+                try:
+                    out = _shm_sweep_spec(self.queue, dead_pids)
+                    if out.get("leases_swept") or out.get("freed"):
+                        logger.info("fleet: shm sweep after reap: %s", out)
+                except Exception as e:  # noqa: BLE001 — sweep is recovery,
+                    logger.warning("fleet: shm sweep failed: %s", e)
             # 2. sample heartbeats -> per-worker occupancy from
             # busy-seconds deltas (rate of chip-busy wall time)
             try:
@@ -537,6 +551,14 @@ class ServingFleet:
                                "SIGKILL", wid)
                 p.kill()
                 p.join(timeout=2)
+        # final shm sweep: no worker pid survives stop(), so any lease a
+        # SIGKILLed worker left behind is dropped here
+        try:
+            _shm_sweep_spec(self.queue,
+                            [p.pid for p in procs.values()
+                             if p.pid is not None])
+        except Exception as e:  # noqa: BLE001 — sweep is best-effort
+            logger.warning("fleet: shm sweep on stop failed: %s", e)
         snap = self.metrics()
         self._events.close()
         REGISTRY.gauge("zoo_fleet_workers_live",

@@ -29,7 +29,9 @@ from ..common import knobs
 from ..obs import trace as _trace
 from ..obs.export import prometheus_text
 from ..obs.registry import REGISTRY, InstancedEvents
-from .codecs import SparseTensor, decode_payload, encode_payload
+from ..shm import arena_for_spec as _shm_arena_for_spec
+from .codecs import (SparseTensor, decode_payload, encode_payload,
+                     encode_payload_ref)
 from .queue_api import Broker, make_broker
 
 
@@ -82,6 +84,11 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
     from aiohttp import web
 
     broker: Broker = make_broker(queue) if isinstance(queue, str) else queue
+    # shm object plane: on a local ZOO_SHM-enabled stream this door writes
+    # each request's raw tensor bytes into arena slabs once and enqueues
+    # descriptors — the engine maps them instead of re-decoding b64(arrow)
+    arena = _shm_arena_for_spec(
+        queue if isinstance(queue, str) else getattr(broker, "spec", None))
     shed_age_s = float(knobs.get("ZOO_FLEET_QUEUE_AGE_SHED_MS")
                        if queue_age_shed_ms is None
                        else queue_age_shed_ms) / 1e3
@@ -307,6 +314,7 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
         # the batcher thread's decode/dispatch spans chain to this request
         tok = _trace.token()
         uris = []
+        items = []
         for data in parsed:
             uri = uuid.uuid4().hex
             meta = {"uri": uri, "deadline": deadline}
@@ -314,8 +322,16 @@ def create_app(queue="memory://serving_stream", timeout_s: float = 30.0,
                 meta["model"] = model_name
             if tok:
                 meta["trace"] = tok
-            broker.enqueue(uri, encode_payload(data, meta=meta))
+            if arena is not None:
+                payload, _refs = encode_payload_ref(data, meta=meta,
+                                                    arena=arena)
+            else:
+                payload = encode_payload(data, meta=meta)
+            items.append((uri, payload))
             uris.append(uri)
+        # one broker batch for the whole request: the file transport pays
+        # a single spool-dir fsync for N instances instead of N
+        broker.publish_many(items)
 
         def fetch(uri):
             raw = broker.get_result(uri, eff_timeout)

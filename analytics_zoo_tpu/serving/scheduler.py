@@ -63,9 +63,10 @@ class ServingRequest:
     """One admitted record: decoded, densified, deadline-stamped, routed."""
 
     __slots__ = ("item_id", "data", "meta", "deadline", "model", "sig",
-                 "trace", "t_admit")
+                 "trace", "t_admit", "shm_refs")
 
-    def __init__(self, item_id: str, data, meta: Dict, model: str):
+    def __init__(self, item_id: str, data, meta: Dict, model: str,
+                 shm_refs=()):
         self.item_id = item_id
         self.data = data
         self.meta = meta
@@ -75,6 +76,10 @@ class ServingRequest:
         self.sig = request_signature(data)
         self.trace = meta.get("trace")
         self.t_admit = time.time()
+        # shm object plane: slab descriptors this request's data is mapped
+        # from — the engine done()s them strictly after the item's answer
+        # is published (empty for inline/legacy payloads)
+        self.shm_refs = tuple(shm_refs)
 
     @property
     def expired(self) -> bool:

@@ -51,6 +51,7 @@ from ..obs import trace as _trace
 from ..obs.registry import REGISTRY
 from ..serving.fleet import _dumps, _loads
 from ..serving.queue_api import make_broker, partitioned_spec
+from ..shm import sweep_spec as _shm_sweep_spec
 from .guardrail import GuardrailEvaluator
 from .serve import StreamingReloader
 from .source import StreamingXShards
@@ -279,11 +280,14 @@ class StreamingFleet:
 
     def _tick(self):
         with self._lock:
+            dead_pids: List[int] = []
             for k, p in list(self._procs.items()):
                 if p.is_alive():
                     continue
                 p.join(timeout=0)
                 del self._procs[k]
+                if p.pid is not None:
+                    dead_pids.append(p.pid)
                 if self._stop.is_set():
                     continue
                 if p.exitcode == 0:
@@ -301,6 +305,18 @@ class StreamingFleet:
                     "stream-fleet: consumer t%d died (exitcode=%s) — "
                     "respawning onto its partition", k, p.exitcode)
                 self._spawn(k)
+            if dead_pids:
+                # shm object plane: a SIGKILLed consumer's slab pins die
+                # with its pid — sweep its lease files; its unacked claims
+                # replay into the respawn and re-resolve still-live blobs
+                try:
+                    out = _shm_sweep_spec(self.queue, dead_pids)
+                    if out.get("leases_swept") or out.get("freed"):
+                        logger.info(
+                            "stream-fleet: shm sweep after reap: %s", out)
+                except Exception as e:  # noqa: BLE001 — sweep is recovery
+                    logger.warning(
+                        "stream-fleet: shm sweep failed: %s", e)
             try:
                 for cid, s in self.router.live_workers(
                         self.consumer_ttl_s).items():
@@ -398,6 +414,13 @@ class StreamingFleet:
                                "SIGTERM — SIGKILL", k)
                 p.kill()
                 p.join(timeout=2)
+        # final shm sweep: no consumer pid survives stop()
+        try:
+            _shm_sweep_spec(self.queue,
+                            [p.pid for p in procs.values()
+                             if p.pid is not None])
+        except Exception as e:  # noqa: BLE001 — sweep is best-effort
+            logger.warning("stream-fleet: shm sweep on stop failed: %s", e)
         snap = self.metrics()
         logger.info("stream-fleet stopped: %s", {
             k: snap[k] for k in ("consumers", "windows_total",

@@ -33,10 +33,11 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["encode_record", "decode_record", "seq_id", "record_key",
-           "partition_for"]
+__all__ = ["encode_record", "decode_record", "decode_ref", "seq_id",
+           "record_key", "partition_for"]
 
 _MAGIC = b"ZSR1"
+_SHM_MAGIC = b"ZSHM1"
 
 
 def seq_id(seq: int) -> str:
@@ -87,15 +88,21 @@ def encode_record(x, y=None, event_time: Optional[float] = None,
     return b"".join(parts)
 
 
-def decode_record(raw: bytes
+def decode_record(raw
                   ) -> Tuple[Tuple[np.ndarray, ...],
                              Optional[Tuple[np.ndarray, ...]], float]:
     """Decode :func:`encode_record` bytes -> (x_tuple, y_tuple|None,
-    event_time). Leaves are zero-copy views into ``raw``."""
-    if raw[:4] != _MAGIC:
+    event_time). Leaves are zero-copy views into ``raw``, which may be
+    any buffer — bytes, a memoryview of a received frame, or a mapped
+    shared-memory slab — sliced via frombuffer, never via ``bytes()``
+    materialization (only the few-hundred-byte JSON header is copied to
+    parse)."""
+    if not isinstance(raw, (bytes, bytearray)):
+        raw = memoryview(raw).cast("B")
+    if bytes(raw[:4]) != _MAGIC:
         raise ValueError("not a streaming record (bad magic)")
     hlen = int.from_bytes(raw[4:8], "big")
-    header = json.loads(raw[8:8 + hlen].decode("utf-8"))
+    header = json.loads(bytes(raw[8:8 + hlen]).decode("utf-8"))
     off = 8 + hlen
 
     def take(specs: Sequence[dict]) -> Tuple[np.ndarray, ...]:
@@ -115,14 +122,36 @@ def decode_record(raw: bytes
     return xs, ys, float(header["t"])
 
 
-def record_key(raw: bytes) -> Optional[str]:
+def decode_ref(raw, arena=None):
+    """Decode a broker payload that may be a shm descriptor envelope:
+    returns ``(x_tuple, y_tuple|None, event_time, ref)``. A descriptor
+    frame maps the slab read-only (zero copy — the leaves are frombuffer
+    views straight into shared memory, C-contiguous, ready for
+    ``sharded_put``) and the caller owes ``arena.done(ref)`` after the
+    entry is acked; inline frames and legacy payloads decode exactly as
+    :func:`decode_record` with ``ref None``."""
+    from ..shm import resolve_blob
+    buf, ref = resolve_blob(raw, arena)
+    x, y, et = decode_record(buf)
+    return x, y, et, ref
+
+
+def record_key(raw) -> Optional[str]:
     """The routing key of an encoded record, or None when the producer
     stamped none. Header-only: the partition router calls this once per
-    enqueue and must not pay an array decode."""
-    if raw[:4] != _MAGIC:
+    enqueue and must not pay an array decode — nor a payload copy:
+    ``raw`` may be any buffer and only the header bytes are touched.
+    Descriptor envelopes (shm plane) carry the key in the envelope
+    header, so sharding survives the descriptor wire."""
+    if not isinstance(raw, (bytes, bytearray)):
+        raw = memoryview(raw).cast("B")
+    if bytes(raw[:5]) == _SHM_MAGIC:
+        from ..shm import envelope_key
+        return envelope_key(raw)
+    if bytes(raw[:4]) != _MAGIC:
         raise ValueError("not a streaming record (bad magic)")
     hlen = int.from_bytes(raw[4:8], "big")
-    k = json.loads(raw[8:8 + hlen].decode("utf-8")).get("k")
+    k = json.loads(bytes(raw[8:8 + hlen]).decode("utf-8")).get("k")
     return None if k is None else str(k)
 
 

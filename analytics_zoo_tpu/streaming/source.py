@@ -52,7 +52,10 @@ from ..obs import trace as _trace
 from ..orca.data.chunked import ChunkedArray
 from ..orca.data.shard import HostXShards
 from ..serving.queue_api import Broker, make_broker
-from .records import decode_record
+from ..shm import StaleObjectRef
+from ..shm import arena_for_spec as _shm_arena_for_spec
+from ..shm import peek_refs as _shm_peek_refs
+from .records import decode_ref as decode_record_ref
 from .stats import StreamingStats
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -214,6 +217,13 @@ class StreamingXShards:
         # trips per record
         self._ack_buf: List[str] = []
         self._polls_since_backlog = 0
+        # shm object plane: on a local ZOO_SHM-enabled stream record
+        # payloads may arrive as slab descriptors — buffered records keep
+        # their ref pinned until the window-commit ack done()s it
+        self._arena = _shm_arena_for_spec(
+            broker if isinstance(broker, str)
+            else getattr(self.broker, "spec", None))
+        self._refs: Dict[str, object] = {}
 
     # --- ingest -------------------------------------------------------------
     def _flush_acks(self):
@@ -230,6 +240,28 @@ class StreamingXShards:
                            "(%s: %s); they will replay through the PEL",
                            len(rids), type(e).__name__, e)
 
+    def _ref_done(self, ref) -> None:
+        """Mark a slab descriptor consumed (no-op for inline/legacy)."""
+        if ref is None or self._arena is None:
+            return
+        try:
+            self._arena.done(ref)
+        except Exception as e:      # noqa: BLE001 — freeing must not
+            # stall ingest; a sweep/gc reclaims whatever this missed
+            logger.warning("shm done failed for %s: %s", ref, e)
+
+    def _peek_done(self, payload) -> None:
+        """Consume-without-decode: mark the payload's descriptors done
+        straight off the envelope header (dedup replays, backlog sheds —
+        paths that never map the slab)."""
+        if self._arena is None:
+            return
+        try:
+            for ref in _shm_peek_refs(payload):
+                self._arena.done(ref)
+        except Exception as e:      # noqa: BLE001 — malformed frame
+            logger.warning("shm peek failed: %s", e)
+
     def _ingest_one(self, rid: str, payload: bytes, cursor: StreamCursor,
                     shedding: bool) -> None:
         if rid <= cursor.last_id:
@@ -237,31 +269,45 @@ class StreamingXShards:
             # ack and drop — exactly-once application
             self.stats.add(records_deduped=1)
             self._ack_buf.append(rid)
+            self._peek_done(payload)
             return
         if rid in self._buf_ids:
             # the same entry delivered twice (XAUTOCLAIM re-stole it while
             # it sat in our buffer): drop the duplicate but do NOT ack —
             # the buffered copy is untrained, and an early ack would turn
             # a crash here into record loss. The window-commit ack clears
-            # every pending delivery of the id at once.
+            # every pending delivery of the id at once. (Its slab ref is
+            # the SAME blob the buffered copy holds pinned — nothing to do)
             self.stats.add(records_deduped=1)
             return
         if shedding:
             self.stats.add(records_shed=1)
             self._ack_buf.append(rid)
+            self._peek_done(payload)
             return
-        x, y, et = decode_record(payload)
+        try:
+            x, y, et, ref = decode_record_ref(payload, self._arena)
+        except StaleObjectRef:
+            # the blob was already consumed (a shed/drop's ack got lost and
+            # the entry replayed past its freed slab): consume the
+            # redelivery too — the record's consumption already happened
+            self.stats.add(records_deduped=1)
+            self._ack_buf.append(rid)
+            return
         self._watermark = max(self._watermark, et - self.watermark_s)
         if et < self._watermark:
             if self.late_policy == "drop":
                 self.stats.add(late_dropped=1)
                 self._ack_buf.append(rid)
+                self._ref_done(ref)
                 return
             self.stats.add(late_included=1)
         if self._buf_t0 is None:
             self._buf_t0 = time.monotonic()
         self._buf.append(_PendingRecord(rid, x, y, et))
         self._buf_ids.add(rid)
+        if ref is not None:
+            self._refs[rid] = ref
 
     def _close_size(self) -> int:
         """Rows the current buffer may close with right now (0 = keep
@@ -381,9 +427,23 @@ class StreamingXShards:
                            "entries will replay through the PEL and dedup "
                            "against the committed cursor",
                            type(e).__name__, e, window.n)
+        # the window's arrays were copied out at assembly; the slabs are
+        # consumed now that the cursor is durable (a replay past this
+        # point dedups by id, never re-maps)
+        for rid in window.ids:
+            self._ref_done(self._refs.pop(rid, None))
         self.stats.add(acks=window.n)
 
     def close(self):
+        # buffered-but-untrained records: drop our pins WITHOUT consuming —
+        # the unacked entries replay after restart and must re-resolve
+        if self._arena is not None:
+            for ref in self._refs.values():
+                try:
+                    self._arena.release(ref)
+                except Exception as e:      # noqa: BLE001 — already freed
+                    logger.warning("shm release failed for %s: %s", ref, e)
+        self._refs.clear()
         close = getattr(self.broker, "close", None)
         if close is not None:
             close()

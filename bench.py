@@ -689,6 +689,183 @@ def bench_streaming_fleet(smoke: bool) -> dict:
     }
 
 
+def _shm_chaos_child(root, ref_dicts):
+    """Attach the arena, pin every blob, die without unwinding — the
+    SIGKILLed-consumer leg of bench_shm (module-level: spawn pickles it)."""
+    import signal as _signal
+
+    from analytics_zoo_tpu import shm as _shm
+    a = _shm.BlobArena(root, create=False)
+    for d in ref_dicts:
+        a.checkout(_shm.ObjectRef.from_dict(d))
+    os.kill(os.getpid(), _signal.SIGKILL)
+
+
+def bench_shm(smoke: bool) -> dict:
+    """Shared-memory object plane bench — three legs on the file
+    transport (the FLEET snapshot's broker, real spool I/O on disk):
+
+    1. **copied bytes + hop latency** — the same ~128/256 KB request
+       tensors pushed through the serving codec inline (today's wire:
+       JSON+base64, the inflated payload materialized, spooled, read
+       back, then b64-decoded) and as slab descriptors (``ZOO_SHM=1``:
+       one copy into the arena, a ~300 B frame through the spool,
+       consumer maps the slab read-only). Headline ``value`` is the
+       ratio of host bytes copied per request, inline / shm — the gate
+       wants >= 2x. Decoded arrays must be BIT-IDENTICAL between legs.
+    2. **SIGKILL chaos** — a consumer process pins live blobs and dies
+       un-unwound; the supervisor-style sweep drops its lease and the
+       drain consumes every blob: 0 leaked segments.
+    3. **fsync batching** — N single enqueues vs one ``publish_many``
+       on the durable spool (each payload still fsynced; the dir fsync
+       amortizes N -> 1).
+    """
+    import multiprocessing as mp
+    import signal
+    import tempfile
+
+    from analytics_zoo_tpu import shm
+    from analytics_zoo_tpu.serving.codecs import (decode_payload,
+                                                  decode_ref,
+                                                  encode_payload,
+                                                  encode_payload_ref)
+    from analytics_zoo_tpu.serving.queue_api import make_broker
+
+    n_msgs = 16 if smoke else 64
+    elems = 32_768 if smoke else 65_536     # f32 -> 128 KB / 256 KB
+    rng = np.random.RandomState(7)
+    tensors = [rng.rand(elems).astype(np.float32) for _ in range(n_msgs)]
+
+    root = tempfile.mkdtemp(prefix="zoo-shm-bench-")
+    prev_shm = os.environ.get("ZOO_SHM")
+    os.environ["ZOO_SHM"] = "1"
+    try:
+        # --- leg 1a: inline serving wire (ZOO_SHM=0: JSON+b64 payloads).
+        # Host bytes copied per request: the encoded payload is
+        # materialized by the producer, written to the spool, read back by
+        # the consumer (3x its inflated ~1.33N size), then base64-decode
+        # materializes the N tensor bytes once more.
+        b_in = make_broker(f"file://{root}/inline")
+        lat_in, copied_in, decoded_in = [], 0, []
+        for i, x in enumerate(tensors):
+            t0 = time.perf_counter()
+            p = encode_payload(x)
+            b_in.enqueue(f"r{i}", p)
+            (rid, raw), = b_in.claim_batch(1, 5.0)
+            data, _meta = decode_payload(raw)
+            decoded_in.append(np.asarray(data))
+            lat_in.append(time.perf_counter() - t0)
+            b_in.ack(rid)
+            copied_in += 3 * len(p) + decoded_in[-1].nbytes
+        # --- leg 1b: descriptor wire, SAME tensors (ZOO_SHM=1). One copy
+        # into the slab; the ~300 B frame rides the spool; the consumer
+        # maps the slab read-only — zero further tensor-byte copies.
+        spec = f"file://{root}/shm"
+        arena = shm.arena_for_spec(spec)
+        if arena is None:
+            raise RuntimeError("shm unavailable on this host")
+        b_ref = make_broker(spec)
+        lat_shm, copied_shm, decoded_shm = [], 0, []
+        for i, x in enumerate(tensors):
+            t0 = time.perf_counter()
+            frame, _prefs = encode_payload_ref(x, arena=arena)
+            b_ref.enqueue(f"r{i}", frame)
+            (rid, raw), = b_ref.claim_batch(1, 5.0)
+            data, _meta, refs = decode_ref(raw, arena=arena)
+            view = np.asarray(data)
+            bit_ok = np.array_equal(view, decoded_in[i])
+            decoded_shm.append(bit_ok)
+            lat_shm.append(time.perf_counter() - t0)
+            b_ref.ack(rid)
+            del data, view          # slab views must die before done/destroy
+            for r in refs:
+                arena.done(r)
+            copied_shm += x.nbytes + 3 * len(frame)
+        bit_identical = all(decoded_shm)
+        shm_leftover = arena.stats()["allocs_live"]
+        copy_ratio = copied_in / max(copied_shm, 1)
+
+        # --- leg 2: SIGKILL chaos sweep ---
+        blob = tensors[0].tobytes()
+        refs = []
+        for i in range(8):
+            r = arena.put(blob)
+            arena.release(r)
+            refs.append(r)
+        child = mp.get_context("spawn").Process(
+            target=_shm_chaos_child,
+            args=(arena.root, [r.to_dict() for r in refs]))
+        child.start()
+        child.join(60)
+        # the child pins BEFORE it SIGKILLs itself, so by the time join
+        # returns its lease file (with live pins) is on disk
+        chaos_killed = child.exitcode == -signal.SIGKILL
+        swept = arena.sweep([child.pid])
+        for r in refs:              # drain: the replayed deliveries consume
+            arena.done(r)
+        leaked = int(arena.stats()["allocs_live"])
+
+        # --- leg 3: fsync batching (count syscalls, not wall time — on
+        # hosts where the journal commit is cheap the timing is pure
+        # noise, but the N-dir-fsyncs -> 1 collapse is deterministic) ---
+        from analytics_zoo_tpu.serving import queue_api as _qa
+        fb = _qa.FileBroker(f"{root}/fsync")
+        real_fsync, counts = os.fsync, [0]
+
+        def _counting_fsync(fd):
+            counts[0] += 1
+            return real_fsync(fd)
+
+        _qa.os.fsync = _counting_fsync
+        try:
+            t0 = time.perf_counter()
+            for k in range(n_msgs):
+                fb.enqueue(f"s{k}", blob)
+            t_single = time.perf_counter() - t0
+            fsyncs_single = counts[0]
+            counts[0] = 0
+            t0 = time.perf_counter()
+            fb.publish_many([(f"m{k}", blob) for k in range(n_msgs)])
+            t_batch = time.perf_counter() - t0
+            fsyncs_batch = counts[0]
+        finally:
+            _qa.os.fsync = real_fsync
+
+        arena.destroy()
+        return {
+            "metric": "shm_copied_bytes_ratio",
+            "value": round(copy_ratio, 2),
+            "unit": "x_inline_over_shm",
+            "vs_baseline": None,
+            "copied_bytes_per_req_inline": copied_in // n_msgs,
+            "copied_bytes_per_req_shm": copied_shm // n_msgs,
+            "hop_p50_ms_inline": round(
+                sorted(lat_in)[len(lat_in) // 2] * 1e3, 3),
+            "hop_p50_ms_shm": round(
+                sorted(lat_shm)[len(lat_shm) // 2] * 1e3, 3),
+            "bit_identical": bool(bit_identical),
+            "hotpath_leftover_allocs": int(shm_leftover),
+            "chaos": {
+                "killed": bool(chaos_killed),
+                "leases_swept": int(swept["leases_swept"]),
+                "leaked_allocs_after_sweep": leaked,
+            },
+            "fsync": {
+                "n_items": n_msgs,
+                "fsyncs_enqueue_loop": fsyncs_single,
+                "fsyncs_publish_many": fsyncs_batch,
+                "enqueue_n_s": round(t_single, 4),
+                "publish_many_s": round(t_batch, 4),
+            },
+        }
+    finally:
+        if prev_shm is None:
+            os.environ.pop("ZOO_SHM", None)
+        else:
+            os.environ["ZOO_SHM"] = prev_shm
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_resnet50(smoke: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -3190,7 +3367,8 @@ def main():
                "comms": bench_comms, "sharding": bench_sharding,
                "resilience": bench_resilience,
                "obs": bench_obs, "streaming": bench_streaming,
-               "streaming_fleet": bench_streaming_fleet}
+               "streaming_fleet": bench_streaming_fleet,
+               "shm": bench_shm}
     # smoke runs must never clobber full-run artifacts (vs_baseline on a
     # reduced workload against a full-scale baseline is meaningless)
     detail_name = "BENCH_DETAIL_SMOKE.json" if smoke else "BENCH_DETAIL.json"

@@ -30,7 +30,7 @@ if [ -n "$fails" ]; then
 fi
 # per-plane snapshot lines (TRANSFER_PLANE= / CKPT_PLANE= / COMMS_PLANE= /
 # SHARDING_PLANE= / RESILIENCE= / SERVING_PLANE= / FLEET= / STREAMING= /
-# ANALYSIS= / OBS=): tiny CPU workloads through each plane's
+# SHM= / ANALYSIS= / OBS=): tiny CPU workloads through each plane's
 # production path, all through the ONE zoo-metrics snapshot codepath
 # (analytics_zoo_tpu/obs/snapshots.py — previously five bespoke heredocs
 # here). One process per plane: the comms/analysis snapshots configure the
@@ -39,7 +39,7 @@ fi
 # fleet block ("fleet": consumers/windows_total/freshness_p99_ratio/
 # guard_rejected/rejected_never_adopted — a 2-consumer sharded run plus
 # one guardrail-rejected poisoned commit). Never affects the exit code.
-for plane in transfer ckpt comms sharding resilience serving fleet streaming analysis obs; do
+for plane in transfer ckpt comms sharding resilience serving fleet streaming shm analysis obs; do
     env JAX_PLATFORMS=cpu \
         python -m analytics_zoo_tpu.obs snapshot "$plane" \
         2>/dev/null | grep -aE '^[A-Z_]+=' || true

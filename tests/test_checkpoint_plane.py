@@ -491,9 +491,9 @@ def test_watcher_concurrent_polls_deliver_each_step_once(tmp_path,
     plane = CheckpointPlane(str(tmp_path), async_save=False)
     real_load = ckpt_fmt.load_checkpoint_dir
 
-    def slow_load(path, passphrase=None):
+    def slow_load(path, passphrase=None, **kw):
         _time.sleep(0.05)       # widen the read-then-deliver race window
-        return real_load(path, passphrase)
+        return real_load(path, passphrase, **kw)
 
     monkeypatch.setattr(watch_mod.fmt, "load_checkpoint_dir", slow_load)
     delivered = []
@@ -530,9 +530,9 @@ def test_watcher_rejected_step_read_once_across_fast_polls(tmp_path,
     reads = []
     real_load = ckpt_fmt.load_checkpoint_dir
 
-    def counting_load(path, passphrase=None):
+    def counting_load(path, passphrase=None, **kw):
         reads.append(path)
-        return real_load(path, passphrase)
+        return real_load(path, passphrase, **kw)
 
     monkeypatch.setattr(watch_mod.fmt, "load_checkpoint_dir", counting_load)
 
